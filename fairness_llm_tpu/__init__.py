@@ -1,0 +1,21 @@
+"""fairness_llm_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework replicating the
+capabilities of ``saakshipatel/fairness-llm-replication``.
+
+The reference (see ``SURVEY.md``) is a three-phase fairness study of LLM-based
+recommenders over MovieLens-1M driven by remote OpenAI API calls. This framework
+runs the same detect -> cross-model-eval -> mitigate pipeline entirely on device:
+
+- ``data/``     — MovieLens-1M loading, counterfactual profile grids, synthetic corpora
+- ``metrics/``  — jit-compiled fairness + ranking metric kernels (DP/IF/EO/exposure/
+                  NDCG/SNSR/SNSV) with on-device ``psum`` reductions
+- ``models/``   — Flax decoder-only transformer family (Llama-3, Mistral, Gemma, GPT-2)
+- ``runtime/``  — KV-cache autoregressive decode engine (jit prefill + ``lax.scan`` decode)
+- ``parallel/`` — device mesh, sharding rules, tensor-parallel decode, ring attention
+- ``ops/``      — Pallas TPU kernels for the hot ops
+- ``pipeline/`` — phase 1/2/3 drivers reproducing the reference's behavior
+- ``training/`` — sharded LM training step (loss + optax update) for fine-tuning
+- ``cli/``      — ``main.py``-equivalent front end (``--all/--phase/--quick``)
+- ``reports/``  — summary printers and figures
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,124 @@
+"""Configuration for the fairness_llm_tpu framework.
+
+The reference keeps its configuration in a gitignored ``src/config.py`` whose schema
+had to be reconstructed from call sites (SURVEY.md Appendix A; e.g. reference
+``main.py:49-52``, ``phase1_bias_detection.py:99,186-187,280``). This module ships a
+real, checked-in equivalent — extended with the TPU-specific knobs (mesh shape, model
+selection, decode settings) that the reference, being a remote-API pipeline, never
+needed.
+
+Everything is a frozen dataclass so configs can be passed through jit boundaries as
+static arguments and hashed for compilation caching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSettings:
+    """Per-model decode settings (reference ``config.MODELS[name]``,
+    used at ``phase1_bias_detection.py:186-187``)."""
+
+    temperature: float = 0.7
+    max_tokens: int = 500
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout. Axes follow the scaling-book convention:
+
+    - ``dp``: data parallel — the profile sweep is batch-sharded over this axis
+    - ``tp``: tensor parallel — attention heads / MLP hidden sharded over this axis
+    - ``sp``: sequence parallel — ring-attention shards the sequence over this axis
+    """
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("dp", "tp", "sp")
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.tp, self.sp)
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.sp
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Top-level framework configuration (reference Appendix-A schema + TPU additions)."""
+
+    # --- reference-parity fields (SURVEY.md Appendix A) ---
+    random_seed: int = 42
+    results_dir: str = "results"
+    data_dir: str = "data/ml-1m"
+    # Counterfactual attribute grid (reference ``SENSITIVE_ATTRIBUTES``; values
+    # confirmed from results/phase1/phase1_results.json profiles).
+    genders: Tuple[str, ...] = ("male", "female", "non-binary")
+    age_groups: Tuple[str, ...] = ("18-24", "25-34", "35-44", "45-54", "55+")
+    occupation: str = "professional"
+    profiles_per_combo: int = 3
+    conformal_alpha: float = 0.1
+    bias_reduction_target: float = 50.0  # percent
+    accuracy_preservation_min: float = 70.0  # percent
+    # Reference ``DEFAULT_MODELS`` (phase1/3: one model; phase2: a sweep).
+    default_model_phase1: str = "tiny-test"
+    default_models_phase2: Tuple[str, ...] = ("tiny-test",)
+    default_model_phase3: str = "tiny-test"
+    model_settings: Tuple[Tuple[str, ModelSettings], ...] = (
+        ("tiny-test", ModelSettings(temperature=0.7, max_tokens=128)),
+        ("gpt2-small", ModelSettings(temperature=0.7, max_tokens=256)),
+        ("llama3-8b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("llama3-70b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("mistral-7b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("gemma-7b", ModelSettings(temperature=0.7, max_tokens=500)),
+    )
+
+    # --- TPU-native additions ---
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    decode_batch_size: int = 16
+    max_prompt_len: int = 512
+    max_new_tokens: int = 256
+    weights_dir: Optional[str] = None  # directory of HF safetensors checkpoints
+    checkpoint_every: int = 20  # profiles between sweep checkpoints (reference: 20)
+    profile_trace_dir: Optional[str] = None  # jax.profiler trace output
+
+    def settings_for(self, model_name: str) -> ModelSettings:
+        for name, settings in self.model_settings:
+            if name == model_name:
+                return settings
+        return ModelSettings()
+
+    @property
+    def sensitive_attributes(self) -> Dict[str, List[str]]:
+        return {"gender": list(self.genders), "age": list(self.age_groups)}
+
+
+def default_config() -> Config:
+    """Build a Config, honoring environment overrides."""
+    kwargs = {}
+    if os.environ.get("FAIRNESS_TPU_RESULTS_DIR"):
+        kwargs["results_dir"] = os.environ["FAIRNESS_TPU_RESULTS_DIR"]
+    if os.environ.get("FAIRNESS_TPU_DATA_DIR"):
+        kwargs["data_dir"] = os.environ["FAIRNESS_TPU_DATA_DIR"]
+    if os.environ.get("FAIRNESS_TPU_SEED"):
+        kwargs["random_seed"] = int(os.environ["FAIRNESS_TPU_SEED"])
+    return Config(**kwargs)
+
+
+def create_directories(config: Config) -> None:
+    """mkdir side-effect helper (reference ``config.create_directories()``,
+    called at ``main.py:56``)."""
+    for sub in ("", "phase1", "phase2", "phase3", "visualizations"):
+        os.makedirs(os.path.join(config.results_dir, sub), exist_ok=True)

@@ -1,0 +1,29 @@
+"""Data layer: MovieLens-1M loading, counterfactual profile grids, synthetic corpora.
+
+Pure Python/numpy — no JAX required at this layer (SURVEY.md §7.1). Deterministic via
+explicit seeds everywhere (the reference left phase-2/3 randomness unseeded,
+SURVEY.md §8.5; we seed all of it).
+"""
+
+from fairness_llm_tpu.data.movielens import (
+    MovieLensData,
+    load_movielens,
+    synthetic_movielens,
+)
+from fairness_llm_tpu.data.profiles import (
+    Profile,
+    create_base_preferences,
+    create_profile_grid,
+)
+from fairness_llm_tpu.data.ranking import RankingItem, create_synthetic_ranking_data
+
+__all__ = [
+    "MovieLensData",
+    "load_movielens",
+    "synthetic_movielens",
+    "Profile",
+    "create_base_preferences",
+    "create_profile_grid",
+    "RankingItem",
+    "create_synthetic_ranking_data",
+]

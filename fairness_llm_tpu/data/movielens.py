@@ -1,0 +1,156 @@
+"""MovieLens-1M loading.
+
+File formats (SURVEY.md Appendix B; reference parse at
+``phase1_bias_detection.py:29-73``):
+
+- ``movies.dat``:  ``movie_id::title (year)::Genre1|Genre2`` (latin-1)
+- ``users.dat``:   ``user_id::gender::age::occupation::zip``
+- ``ratings.dat``: ``user_id::movie_id::rating::timestamp``
+
+The reference reads these with pandas' python engine and ``sep='::'``; here the hot
+parse is a hand-rolled splitter (optionally accelerated by the C extension in
+``fairness_llm_tpu/native``) feeding numpy arrays directly, which is both faster and
+dependency-lighter. When the dataset is absent we fall back to a seeded synthetic
+corpus, mirroring the reference's fallback behavior
+(``phase1_bias_detection.py:288-306``) but deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class MovieLensData:
+    """Columnar MovieLens tables.
+
+    ``movie_ids``/``titles``/``genres`` are aligned; ratings are parallel arrays of
+    int32/float32 so downstream aggregation is vectorized numpy, not row loops.
+    """
+
+    movie_ids: np.ndarray  # int32 [M]
+    titles: List[str]  # [M]
+    genres: List[List[str]]  # [M]
+    rating_user_ids: np.ndarray  # int32 [R]
+    rating_movie_ids: np.ndarray  # int32 [R]
+    rating_values: np.ndarray  # float32 [R]
+    synthetic: bool = False
+
+    @property
+    def num_movies(self) -> int:
+        return len(self.movie_ids)
+
+    @property
+    def num_ratings(self) -> int:
+        return len(self.rating_values)
+
+    def title_of(self) -> Dict[int, str]:
+        return dict(zip(self.movie_ids.tolist(), self.titles))
+
+    def genres_of(self) -> Dict[int, List[str]]:
+        return dict(zip(self.movie_ids.tolist(), self.genres))
+
+
+def _parse_dat(path: str, encoding: str = "latin-1") -> List[List[str]]:
+    """Parse a ``::``-separated .dat file into rows of string fields.
+
+    Uses the native C parser when available (fairness_llm_tpu.native), falling back
+    to pure Python.
+    """
+    try:
+        from fairness_llm_tpu.native import parse_dat_file  # C extension
+
+        return parse_dat_file(path, encoding)
+    except Exception:  # noqa: BLE001 — extension absent or failed; pure-python path
+        rows = []
+        with open(path, "r", encoding=encoding) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    rows.append(line.split("::"))
+        return rows
+
+
+def load_movielens(data_dir: str, allow_synthetic: bool = True, seed: int = 42) -> MovieLensData:
+    """Load MovieLens-1M from ``data_dir`` (movies.dat / ratings.dat required).
+
+    ``users.dat`` is intentionally unused: the pipeline builds *synthetic*
+    counterfactual users (reference behavior — ``users.dat`` is loaded but never
+    consumed downstream of ``load_movielens_data``).
+
+    Missing files trigger the synthetic fallback (reference
+    ``run_phase1``/``phase1_bias_detection.py:288-306``) unless
+    ``allow_synthetic=False``.
+    """
+    movies_path = os.path.join(data_dir, "movies.dat")
+    ratings_path = os.path.join(data_dir, "ratings.dat")
+
+    if not os.path.exists(movies_path) or not os.path.exists(ratings_path):
+        if not allow_synthetic:
+            raise FileNotFoundError(f"MovieLens data not found under {data_dir}")
+        logger.warning("MovieLens data missing under %s — using synthetic fallback", data_dir)
+        return synthetic_movielens(seed=seed)
+
+    movie_rows = _parse_dat(movies_path)
+    movie_ids = np.array([int(r[0]) for r in movie_rows], dtype=np.int32)
+    titles = [r[1] for r in movie_rows]
+    genres = [r[2].split("|") for r in movie_rows]
+
+    rating_rows = _parse_dat(ratings_path)
+    r_users = np.array([int(r[0]) for r in rating_rows], dtype=np.int32)
+    r_movies = np.array([int(r[1]) for r in rating_rows], dtype=np.int32)
+    r_values = np.array([float(r[2]) for r in rating_rows], dtype=np.float32)
+
+    logger.info("Loaded MovieLens: %d movies, %d ratings", len(movie_ids), len(r_values))
+    return MovieLensData(movie_ids, titles, genres, r_users, r_movies, r_values)
+
+
+# Genre pool for the synthetic corpus (the 18 MovieLens-1M genres).
+_GENRES = [
+    "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+    "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+    "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+]
+
+
+def synthetic_movielens(
+    num_movies: int = 200,
+    num_users: int = 200,
+    ratings_per_user: int = 40,
+    seed: int = 42,
+) -> MovieLensData:
+    """Seeded synthetic stand-in for MovieLens-1M.
+
+    The reference builds a 100-movie/100-rating frame on ``FileNotFoundError``
+    (``phase1_bias_detection.py:294-306``); this version is larger and fully seeded
+    so tests and the quick path are deterministic.
+    """
+    rng = np.random.default_rng(seed)
+    movie_ids = np.arange(1, num_movies + 1, dtype=np.int32)
+    years = rng.integers(1950, 2001, size=num_movies)
+    titles = [f"Synthetic Movie {i} ({y})" for i, y in zip(movie_ids, years)]
+    genres = [
+        sorted(rng.choice(_GENRES, size=rng.integers(1, 4), replace=False).tolist())
+        for _ in range(num_movies)
+    ]
+
+    r_users = np.repeat(np.arange(1, num_users + 1, dtype=np.int32), ratings_per_user)
+    r_movies = rng.choice(movie_ids, size=num_users * ratings_per_user).astype(np.int32)
+    # Skew ratings high for a subset of "good" movies so the quality filter
+    # (avg >= 4.0, >= min_ratings) keeps a nontrivial pool.
+    good = rng.choice(movie_ids, size=num_movies // 3, replace=False)
+    is_good = np.isin(r_movies, good)
+    r_values = np.where(
+        is_good,
+        rng.choice([4.0, 4.5, 5.0], size=r_users.shape),
+        rng.choice([2.0, 2.5, 3.0, 3.5, 4.0], size=r_users.shape),
+    ).astype(np.float32)
+
+    return MovieLensData(movie_ids, titles, genres, r_users, r_movies, r_values, synthetic=True)

@@ -1,0 +1,38 @@
+"""Synthetic ranking corpus for phase 2 (cross-model ranking-fairness eval).
+
+The reference generates 20 "Document i" items with a random protected attribute in
+{male, female} and random relevance in [0.3, 1.0] — with *unseeded* numpy RNG
+(``phase2_cross_model_eval.py:27-43``; flagged in SURVEY.md §8.5). This version is
+identical in distribution but fully seeded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RankingItem:
+    id: int
+    text: str
+    protected_attribute: str  # "male" | "female"
+    relevance: float
+
+
+def create_synthetic_ranking_data(num_items: int = 20, seed: int = 42) -> List[RankingItem]:
+    """Items to be ranked, each tagged with a protected group and a true relevance."""
+    rng = np.random.default_rng(seed)
+    items = []
+    for i in range(num_items):
+        items.append(
+            RankingItem(
+                id=i,
+                text=f"Document {i}: A relevant document about topic {i % 5}",
+                protected_attribute=str(rng.choice(["male", "female"])),
+                relevance=float(rng.uniform(0.3, 1.0)),
+            )
+        )
+    return items

@@ -1,0 +1,56 @@
+"""Metric kernels: fairness + ranking quality, jit-compiled with fixed shapes.
+
+Design (SURVEY.md §7.2): free-text items (movie titles) are interned into integer IDs
+over a vocabulary (``encode.py``) so set operations become one-hot masks and
+segment-sums; every kernel then runs on fixed-shape int/float arrays under ``jit``
+and reduces with ``psum``-compatible sums, so a sweep sharded over a ``dp`` mesh axis
+reduces on device.
+
+The scalar semantics replicate the reference's numpy/scipy math exactly
+(``utils.py:70-305``; golden-tested against the committed reference results):
+
+- demographic parity  = 1 - mean pairwise Jensen-Shannon *distance* (scipy
+  convention: sqrt of JS divergence, natural log) between per-group item
+  distributions, with 1e-10 epsilon for union-support items missing in one group
+- individual fairness = mean Jaccard similarity over counterfactual profile pairs
+- equal opportunity   = 1 / (1 + var(per-group hit-rate))
+- exposure ratio      = min/max of group-mean positional exposure 1/log2(pos+2)
+- NDCG / P@k / R@k / F1 / catalog coverage
+- SNSR / SNSV (Zhang et al. FaiRLLM benchmark; BASELINE.json's tracked metric):
+  sensitive-to-neutral similarity range / variance — net-new vs the reference,
+  which only approximates them with Jaccard-based individual fairness.
+"""
+
+from fairness_llm_tpu.metrics.encode import Vocab, encode_rec_lists
+from fairness_llm_tpu.metrics.divergence import js_distance, kl_divergence
+from fairness_llm_tpu.metrics.fairness import (
+    demographic_parity,
+    equal_opportunity,
+    exposure_ratio,
+    individual_fairness,
+    snsr_snsv,
+)
+from fairness_llm_tpu.metrics.ranking import (
+    catalog_coverage,
+    f1_score,
+    ndcg,
+    precision_at_k,
+    recall_at_k,
+)
+
+__all__ = [
+    "Vocab",
+    "encode_rec_lists",
+    "js_distance",
+    "kl_divergence",
+    "demographic_parity",
+    "individual_fairness",
+    "equal_opportunity",
+    "exposure_ratio",
+    "snsr_snsv",
+    "ndcg",
+    "precision_at_k",
+    "recall_at_k",
+    "f1_score",
+    "catalog_coverage",
+]

@@ -1,0 +1,61 @@
+"""Jensen-Shannon / Kullback-Leibler divergence kernels.
+
+Replicates the reference's scipy-based math (``utils.py:70-102``) as jittable
+fixed-shape kernels. The reference's demographic parity uses
+``scipy.spatial.distance.jensenshannon``, which returns the JS *distance*
+(sqrt of the divergence) with natural log — that convention is preserved here,
+golden-tested against the committed reference results.
+
+Union-support epsilon semantics (``utils.py:93-100``): for a pair of
+count-derived distributions, items present in either distribution form the
+support; an item missing from one side contributes ``eps = 1e-10`` there; both
+sides are renormalized over the support before the divergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-10
+
+
+def _safe_xlogx_over_y(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """rel_entr(x, y) = x * log(x / y) with 0*log(0/..) = 0."""
+    ratio = jnp.where((x > 0) & (y > 0), x / jnp.where(y > 0, y, 1.0), 1.0)
+    return jnp.where(x > 0, x * jnp.log(ratio), 0.0)
+
+
+@jax.jit
+def kl_divergence(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """KL(p || q) over probability vectors (natural log), inputs renormalized."""
+    p = p / jnp.sum(p)
+    q = q / jnp.sum(q)
+    return jnp.sum(_safe_xlogx_over_y(p, q))
+
+
+@jax.jit
+def js_distance(p_counts: jnp.ndarray, q_counts: jnp.ndarray) -> jnp.ndarray:
+    """JS distance between two count vectors over a shared vocab [V].
+
+    Matches ``scipy.spatial.distance.jensenshannon`` applied the reference's way:
+    support = union of nonzero items, eps fill for one-sided misses, renormalize.
+    """
+    support = (p_counts > 0) | (q_counts > 0)
+    p_tot = jnp.sum(p_counts)
+    q_tot = jnp.sum(q_counts)
+    # Group distributions (count/total), eps where missing within the support.
+    p = jnp.where(support, jnp.where(p_counts > 0, p_counts / jnp.maximum(p_tot, 1.0), EPS), 0.0)
+    q = jnp.where(support, jnp.where(q_counts > 0, q_counts / jnp.maximum(q_tot, 1.0), EPS), 0.0)
+    p = p / jnp.sum(p)
+    q = q / jnp.sum(q)
+    m = 0.5 * (p + q)
+    js_div = 0.5 * (jnp.sum(_safe_xlogx_over_y(p, m)) + jnp.sum(_safe_xlogx_over_y(q, m)))
+    return jnp.sqrt(jnp.maximum(js_div, 0.0))
+
+
+@jax.jit
+def pairwise_js_matrix(group_counts: jnp.ndarray) -> jnp.ndarray:
+    """All-pairs JS distance over [G, V] group count rows -> [G, G] (vmapped)."""
+    f = jax.vmap(jax.vmap(js_distance, in_axes=(None, 0)), in_axes=(0, None))
+    return f(group_counts, group_counts)

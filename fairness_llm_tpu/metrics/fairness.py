@@ -1,0 +1,268 @@
+"""Fairness metric kernels + reference-parity wrappers.
+
+Each metric has two faces:
+
+- a ``*_kernel`` operating on fixed-shape arrays under ``jit`` (counts, one-hot
+  membership, ID rows) — the on-device path, composable with ``psum`` when count
+  matrices are accumulated across a ``dp`` mesh axis;
+- a Python wrapper with the reference's dict-of-strings signature and return shape
+  (score + details), used by the phase drivers and golden-tested against the
+  committed reference results (reference math at ``utils.py:172-305``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairness_llm_tpu.metrics.divergence import js_distance, pairwise_js_matrix
+from fairness_llm_tpu.metrics.encode import (
+    Vocab,
+    count_matrix,
+    encode_rec_lists,
+    one_hot_membership,
+)
+
+# ---------------------------------------------------------------------------
+# Demographic parity: 1 - mean pairwise JS distance between group distributions
+# (reference ``calculate_demographic_parity``, utils.py:172-215)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def demographic_parity_kernel(group_counts: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[G, V] per-group item counts -> (parity score, [G, G] JS matrix).
+
+    Pairs where either group has no items are excluded from the mean
+    (reference skips empty distributions, utils.py:200).
+    """
+    js = pairwise_js_matrix(group_counts)
+    totals = jnp.sum(group_counts, axis=-1)
+    g = group_counts.shape[0]
+    iu, ju = jnp.triu_indices(g, k=1)
+    valid = (totals[iu] > 0) & (totals[ju] > 0)
+    pair_js = js[iu, ju]
+    n_valid = jnp.maximum(jnp.sum(valid), 1)
+    avg = jnp.sum(jnp.where(valid, pair_js, 0.0)) / n_valid
+    avg = jnp.where(jnp.sum(valid) > 0, avg, 0.0)
+    return 1.0 - avg, js
+
+
+def demographic_parity(
+    recommendations_by_group: Dict[str, List[List[str]]],
+) -> Tuple[float, Dict]:
+    """Reference-parity wrapper: dict of group -> list of rec lists."""
+    groups = list(recommendations_by_group.keys())
+    flat: List[List[str]] = []
+    owners: List[int] = []
+    for gi, g in enumerate(groups):
+        for recs in recommendations_by_group[g]:
+            flat.append(list(recs))
+            owners.append(gi)
+    if not flat:
+        return 0.0, {"divergences": [], "distributions": {}, "avg_divergence": 0.0}
+
+    ids, vocab = encode_rec_lists(flat)
+    per_list = count_matrix(ids, len(vocab))  # [N, V]
+    group_counts = np.zeros((len(groups), len(vocab)), dtype=np.float32)
+    np.add.at(group_counts, np.asarray(owners), per_list)
+
+    score, js = demographic_parity_kernel(jnp.asarray(group_counts))
+    js = np.asarray(js)
+    totals = group_counts.sum(axis=-1)
+
+    divergences = []
+    for i in range(len(groups)):
+        for j in range(i + 1, len(groups)):
+            if totals[i] > 0 and totals[j] > 0:
+                divergences.append(float(js[i, j]))
+    distributions = {}
+    for gi, g in enumerate(groups):
+        t = totals[gi]
+        distributions[g] = (
+            {vocab.items[v]: float(group_counts[gi, v] / t) for v in np.nonzero(group_counts[gi])[0]}
+            if t > 0
+            else {}
+        )
+    avg = float(np.mean(divergences)) if divergences else 0.0
+    return float(score), {
+        "divergences": divergences,
+        "distributions": distributions,
+        "avg_divergence": avg,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Individual fairness: mean Jaccard over counterfactual profile pairs
+# (reference ``calculate_individual_fairness``, utils.py:217-244)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def jaccard_pairs_kernel(membership: jnp.ndarray, pairs: jnp.ndarray) -> jnp.ndarray:
+    """[P, V] bool membership + [M, 2] index pairs -> [M] Jaccard similarities.
+
+    Empty-vs-empty pairs score 1.0 (reference utils.py:232-233).
+    """
+    a = membership[pairs[:, 0]]
+    b = membership[pairs[:, 1]]
+    inter = jnp.sum(a & b, axis=-1)
+    union = jnp.sum(a | b, axis=-1)
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1), 1.0)
+
+
+def individual_fairness(
+    profile_pairs: Sequence[Tuple[str, str]],
+    recommendations: Dict[str, List[str]],
+) -> Tuple[float, List[float]]:
+    """Reference-parity wrapper: (pid, pid) pairs + pid -> rec list."""
+    pids = list(recommendations.keys())
+    pid_index = {p: i for i, p in enumerate(pids)}
+    valid_pairs = [
+        (pid_index[a], pid_index[b])
+        for a, b in profile_pairs
+        if a in pid_index and b in pid_index
+    ]
+    if not valid_pairs:
+        return 0.0, []
+    ids, vocab = encode_rec_lists([recommendations[p] for p in pids])
+    membership = one_hot_membership(ids, max(len(vocab), 1))
+    sims = jaccard_pairs_kernel(jnp.asarray(membership), jnp.asarray(valid_pairs, dtype=np.int32))
+    sims_list = [float(s) for s in np.asarray(sims)]
+    return float(np.mean(sims_list)), sims_list
+
+
+# ---------------------------------------------------------------------------
+# Equal opportunity: 1 / (1 + var(per-group hit-rate))
+# (reference ``calculate_equal_opportunity``, utils.py:246-275)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def equal_opportunity_kernel(
+    group_counts: jnp.ndarray, relevant_mask: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[G, V] counts + [V] relevant mask -> (score, [G] per-group hit rates).
+
+    Hit rate = |unique recommended ∩ relevant| / total recommended (duplicates
+    count in the denominator only — exactly the reference's set-vs-len math).
+    """
+    unique_hits = jnp.sum((group_counts > 0) & relevant_mask[None, :], axis=-1)
+    totals = jnp.sum(group_counts, axis=-1)
+    rates = jnp.where(totals > 0, unique_hits / jnp.maximum(totals, 1.0), 0.0)
+    variance = jnp.var(rates)
+    return 1.0 / (1.0 + variance), rates
+
+
+def equal_opportunity(
+    recommendations_by_group: Dict[str, List[List[str]]],
+    relevant_items: Set[str],
+) -> Tuple[float, Dict[str, float]]:
+    """Reference-parity wrapper."""
+    groups = list(recommendations_by_group.keys())
+    if not groups:
+        return 1.0, {}
+    vocab = Vocab()
+    group_rows = []
+    for g in groups:
+        flat = [item for recs in recommendations_by_group[g] for item in recs]
+        group_rows.append(flat)
+    ids, vocab = encode_rec_lists(group_rows, vocab)
+    for item in relevant_items:
+        vocab.add(item)
+    counts = count_matrix(ids, len(vocab))
+    relevant_mask = np.zeros(len(vocab), dtype=bool)
+    for item in relevant_items:
+        relevant_mask[vocab[item]] = True
+    score, rates = equal_opportunity_kernel(jnp.asarray(counts), jnp.asarray(relevant_mask))
+    return float(score), {g: float(r) for g, r in zip(groups, np.asarray(rates))}
+
+
+# ---------------------------------------------------------------------------
+# Exposure ratio: min/max of group-mean positional exposure 1/log2(pos+2)
+# (reference utils.py:277-305 and phase2_cross_model_eval.py:216-254)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def exposure_ratio_kernel(
+    position_groups: jnp.ndarray, num_groups: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[N] group index per ranked position (PAD=-1 ignored) -> (ratio, [G] means)."""
+    n = position_groups.shape[0]
+    positions = jnp.arange(n)
+    exposure = 1.0 / jnp.log2(positions + 2.0)
+    valid = position_groups >= 0
+    g = jnp.where(valid, position_groups, 0)
+    sums = jax.ops.segment_sum(jnp.where(valid, exposure, 0.0), g, num_segments=num_groups)
+    counts = jax.ops.segment_sum(jnp.where(valid, 1.0, 0.0), g, num_segments=num_groups)
+    means = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), jnp.nan)
+    present = counts > 0
+    big = jnp.where(present, means, -jnp.inf)
+    small = jnp.where(present, means, jnp.inf)
+    mx = jnp.max(big)
+    mn = jnp.min(small)
+    ratio = jnp.where((jnp.sum(present) > 0) & (mx > 0), mn / jnp.maximum(mx, 1e-30), 1.0)
+    return ratio, means
+
+
+def exposure_ratio(
+    ranked_groups: Sequence[str], group_order: Optional[List[str]] = None
+) -> Tuple[float, Dict[str, float]]:
+    """Reference-parity wrapper: group label per ranked position, top first."""
+    if not ranked_groups:
+        return 1.0, {}
+    groups = group_order or sorted(set(ranked_groups))
+    gidx = {g: i for i, g in enumerate(groups)}
+    arr = np.array([gidx[g] for g in ranked_groups], dtype=np.int32)
+    ratio, means = exposure_ratio_kernel(jnp.asarray(arr), len(groups))
+    means = np.asarray(means)
+    return float(ratio), {
+        g: float(means[i]) for g, i in gidx.items() if not np.isnan(means[i])
+    }
+
+
+# ---------------------------------------------------------------------------
+# SNSR / SNSV (Zhang et al., FaiRLLM): sensitive-to-neutral similarity range /
+# variance. Net-new vs the reference (BASELINE.json tracked metric).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def snsr_snsv_kernel(
+    neutral_membership: jnp.ndarray, group_membership: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """[V] neutral one-hot + [G, V] per-group one-hot -> (snsr, snsv, [G] sims).
+
+    Similarity is Jaccard between each sensitive group's recommendations and the
+    neutral (no-attribute) recommendations; SNSR = max - min, SNSV = population
+    std over groups.
+    """
+    inter = jnp.sum(group_membership & neutral_membership[None, :], axis=-1)
+    union = jnp.sum(group_membership | neutral_membership[None, :], axis=-1)
+    sims = jnp.where(union > 0, inter / jnp.maximum(union, 1), 1.0)
+    return jnp.max(sims) - jnp.min(sims), jnp.std(sims), sims
+
+
+def snsr_snsv(
+    neutral_recs: List[str], recs_by_group: Dict[str, List[str]]
+) -> Tuple[float, float, Dict[str, float]]:
+    """SNSR/SNSV from a neutral rec list and per-sensitive-value rec lists."""
+    groups = list(recs_by_group.keys())
+    if not groups:
+        return 0.0, 0.0, {}
+    rows = [neutral_recs] + [recs_by_group[g] for g in groups]
+    ids, vocab = encode_rec_lists(rows)
+    membership = one_hot_membership(ids, max(len(vocab), 1))
+    snsr, snsv, sims = snsr_snsv_kernel(
+        jnp.asarray(membership[0]), jnp.asarray(membership[1:])
+    )
+    return (
+        float(snsr),
+        float(snsv),
+        {g: float(s) for g, s in zip(groups, np.asarray(sims))},
+    )

@@ -1,0 +1,54 @@
+"""Ranking-quality metrics: NDCG, precision/recall@k, F1, catalog coverage.
+
+Reference math at ``utils.py:113-169``; kernels are fixed-shape and jittable so
+per-group NDCG over a sharded eval reduces on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def ndcg_kernel(relevances: jnp.ndarray, ideal_relevances: jnp.ndarray) -> jnp.ndarray:
+    """DCG(rel)/DCG(ideal) with rel_i / log2(i+2) discounting; 0 when IDCG=0."""
+    positions = jnp.arange(relevances.shape[0])
+    discount = 1.0 / jnp.log2(positions + 2.0)
+    dcg = jnp.sum(relevances * discount)
+    idcg = jnp.sum(ideal_relevances * discount)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-30), 0.0)
+
+
+def ndcg(rankings: Sequence[str], ground_truth: Dict[str, float], k: int = 10) -> float:
+    """Reference-parity wrapper (``utils.calculate_ndcg``, utils.py:113-132)."""
+    rels = np.array([ground_truth.get(item, 0.0) for item in rankings[:k]], dtype=np.float32)
+    ideal = np.array(sorted(ground_truth.values(), reverse=True)[:k], dtype=np.float32)
+    n = max(len(rels), len(ideal), 1)
+    rels = np.pad(rels, (0, n - len(rels)))
+    ideal = np.pad(ideal, (0, n - len(ideal)))
+    return float(ndcg_kernel(jnp.asarray(rels), jnp.asarray(ideal)))
+
+
+def precision_at_k(recommendations: Sequence[str], relevant_items: Set[str], k: int = 10) -> float:
+    top_k = set(recommendations[:k])
+    return len(top_k & relevant_items) / k if k > 0 else 0.0
+
+
+def recall_at_k(recommendations: Sequence[str], relevant_items: Set[str], k: int = 10) -> float:
+    top_k = set(recommendations[:k])
+    return len(top_k & relevant_items) / len(relevant_items) if relevant_items else 0.0
+
+
+def f1_score(precision: float, recall: float) -> float:
+    if precision + recall == 0:
+        return 0.0
+    return 2 * precision * recall / (precision + recall)
+
+
+def catalog_coverage(all_recommendations: Sequence[Sequence[str]], catalog_size: int) -> float:
+    unique = {item for recs in all_recommendations for item in recs}
+    return len(unique) / catalog_size * 100 if catalog_size > 0 else 0.0

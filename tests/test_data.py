@@ -1,0 +1,93 @@
+"""Data-layer tests: loaders, profile grid, synthetic corpora."""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import Config
+from fairness_llm_tpu.data import (
+    create_base_preferences,
+    create_profile_grid,
+    create_synthetic_ranking_data,
+    load_movielens,
+    synthetic_movielens,
+)
+from fairness_llm_tpu.data.profiles import profile_pairs
+
+
+def test_synthetic_movielens_deterministic():
+    a = synthetic_movielens(seed=7)
+    b = synthetic_movielens(seed=7)
+    assert a.titles == b.titles
+    assert np.array_equal(a.rating_values, b.rating_values)
+    assert a.num_movies == 200
+
+
+def test_load_movielens_falls_back_to_synthetic(tmp_path):
+    data = load_movielens(str(tmp_path), allow_synthetic=True)
+    assert data.synthetic
+    with pytest.raises(FileNotFoundError):
+        load_movielens(str(tmp_path), allow_synthetic=False)
+
+
+def test_load_movielens_parses_dat_files(tmp_path):
+    (tmp_path / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Heat (1995)::Action|Crime|Thriller\n",
+        encoding="latin-1",
+    )
+    (tmp_path / "ratings.dat").write_text(
+        "1::1::5::978300760\n1::2::4::978302109\n2::1::4::978301968\n"
+    )
+    data = load_movielens(str(tmp_path))
+    assert not data.synthetic
+    assert data.titles == ["Toy Story (1995)", "Heat (1995)"]
+    assert data.genres[0] == ["Animation", "Children's", "Comedy"]
+    assert data.num_ratings == 3
+    assert data.rating_values[0] == 5.0
+
+
+def test_base_preferences_seeded_and_filtered():
+    data = synthetic_movielens(seed=3)
+    prefs1 = create_base_preferences(data, seed=11)
+    prefs2 = create_base_preferences(data, seed=11)
+    assert prefs1["watched_movies"] == prefs2["watched_movies"]
+    assert len(prefs1["watched_movies"]) == 10
+    assert 1 <= len(prefs1["favorite_genres"]) <= 3
+    assert prefs1["avg_rating"] == 4.5
+
+
+def test_profile_grid_shape_and_ids():
+    config = Config()
+    prefs = {"watched_movies": ["A", "B"], "favorite_genres": ["Drama"], "avg_rating": 4.5}
+    profiles = create_profile_grid(prefs, config)
+    # 3 genders x 5 ages x 3 = 45 (reference default)
+    assert len(profiles) == 45
+    assert profiles[0].id == "user_0000"
+    assert profiles[-1].id == "user_0044"
+    assert {p.gender for p in profiles} == set(config.genders)
+    assert {p.age for p in profiles} == set(config.age_groups)
+    assert all(p.occupation == "professional" for p in profiles)
+    d = profiles[0].to_dict()
+    assert d["preferences"]["watched_movies"] == ["A", "B"]
+
+
+def test_profile_pairs_differ_in_exactly_one_attribute():
+    config = Config()
+    prefs = {"watched_movies": [], "favorite_genres": [], "avg_rating": 4.5}
+    profiles = create_profile_grid(prefs, config, num_profiles_per_combination=1)
+    pairs = profile_pairs(profiles)
+    by_id = {p.id: p for p in profiles}
+    for a, b in pairs:
+        pa, pb = by_id[a], by_id[b]
+        diffs = sum(getattr(pa, attr) != getattr(pb, attr) for attr in ("gender", "age", "occupation"))
+        assert diffs == 1
+    # 15 profiles: same-age cross-gender pairs 5*C(3,2)=15, same-gender cross-age 3*C(5,2)=30
+    assert len(pairs) == 45
+
+
+def test_ranking_data_seeded():
+    a = create_synthetic_ranking_data(20, seed=5)
+    b = create_synthetic_ranking_data(20, seed=5)
+    assert [i.relevance for i in a] == [i.relevance for i in b]
+    assert all(i.protected_attribute in ("male", "female") for i in a)
+    assert all(0.3 <= i.relevance <= 1.0 for i in a)

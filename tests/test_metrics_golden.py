@@ -1,0 +1,85 @@
+"""Golden-value tests: the jit metric kernels must reproduce the reference's
+committed phase-1 numbers (BASELINE.md) when replaying its saved raw
+recommendations.
+
+Targets (reference results/phase1/phase1_summary_report.txt):
+  demographic parity (gender) = 0.6772, (age) = 0.6472
+  individual fairness         = 0.4669
+  equal opportunity           = 1.0000 (vacuous — title-matching bug, SURVEY §8.2)
+
+Tolerance: the kernels run in float32 (TPU-native); the reference computes in
+float64 numpy. Observed deltas are ~5e-6, so 1e-4 absolute keeps us four
+decimal places of agreement — far inside BASELINE.md's ±1% fidelity bar.
+"""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.data.profiles import Profile, profile_pairs
+from fairness_llm_tpu.metrics import (
+    demographic_parity,
+    equal_opportunity,
+    individual_fairness,
+)
+
+# The reference's hard-coded "qualified movies" set (phase1_bias_detection.py:248-252)
+QUALIFIED_MOVIES = {
+    "The Shawshank Redemption", "The Godfather", "The Dark Knight",
+    "Pulp Fiction", "Forrest Gump", "Inception", "The Matrix",
+    "Goodfellas", "The Silence of the Lambs", "Saving Private Ryan",
+}
+
+
+def _group_recs(results, attribute):
+    """Reference ``organize_by_attribute`` semantics (utils.py:308-325)."""
+    grouped = {}
+    recs = results["recommendations"]
+    for prof in results["profiles"]:
+        r = recs.get(prof["id"], {}).get("recommendations", [])
+        if r:
+            grouped.setdefault(prof[attribute], []).append(r)
+    return grouped
+
+
+def test_demographic_parity_gender_golden(reference_phase1_results):
+    grouped = _group_recs(reference_phase1_results, "gender")
+    score, details = demographic_parity(grouped)
+    assert score == pytest.approx(0.6771792137547745, abs=1e-4)
+    saved = reference_phase1_results["metrics"]["demographic_parity"]["gender"]
+    assert sorted(details["divergences"]) == pytest.approx(sorted(saved["details"]["divergences"]), abs=1e-4)
+
+
+def test_demographic_parity_age_golden(reference_phase1_results):
+    grouped = _group_recs(reference_phase1_results, "age")
+    score, _ = demographic_parity(grouped)
+    assert score == pytest.approx(0.6471573268458267, abs=1e-4)
+
+
+def test_individual_fairness_golden(reference_phase1_results):
+    profiles = [
+        Profile(
+            id=p["id"], gender=p["gender"], age=p["age"], occupation=p["occupation"],
+            watched_movies=p["preferences"]["watched_movies"],
+            favorite_genres=p["preferences"]["favorite_genres"],
+        )
+        for p in reference_phase1_results["profiles"]
+    ]
+    pairs = profile_pairs(profiles)
+    recs = {
+        pid: r["recommendations"]
+        for pid, r in reference_phase1_results["recommendations"].items()
+        if "recommendations" in r
+    }
+    score, sims = individual_fairness(pairs, recs)
+    # 45 profiles -> 405 single-attribute-differing pairs (SURVEY §3.2)
+    assert len(sims) == 405
+    assert score == pytest.approx(0.4668974533898281, abs=1e-4)
+
+
+def test_equal_opportunity_golden_vacuous(reference_phase1_results):
+    grouped = _group_recs(reference_phase1_results, "gender")
+    score, by_group = equal_opportunity(grouped, QUALIFIED_MOVIES)
+    # Titles carry year suffixes, the qualified set doesn't -> all-zero hit rates
+    # -> var 0 -> EO = 1.0 (reference bug preserved as documented behavior).
+    assert score == pytest.approx(1.0)
+    assert all(v == 0.0 for v in by_group.values())

@@ -1,0 +1,125 @@
+"""Unit tests for metric kernels against scipy/numpy ground truth."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance
+import scipy.stats
+
+from fairness_llm_tpu.metrics import (
+    catalog_coverage,
+    demographic_parity,
+    equal_opportunity,
+    exposure_ratio,
+    f1_score,
+    individual_fairness,
+    js_distance,
+    kl_divergence,
+    ndcg,
+    precision_at_k,
+    recall_at_k,
+    snsr_snsv,
+)
+
+
+def test_kl_matches_scipy():
+    p = np.array([0.5, 0.3, 0.2])
+    q = np.array([0.4, 0.4, 0.2])
+    ours = float(kl_divergence(p, q))
+    assert ours == pytest.approx(float(scipy.stats.entropy(p, q)), abs=1e-4)
+
+
+def test_js_distance_matches_scipy_with_eps_semantics():
+    # Two count vectors with disjoint-ish support, reference-style eps fill.
+    p_counts = np.array([3.0, 1.0, 0.0, 2.0])
+    q_counts = np.array([0.0, 2.0, 4.0, 0.0])
+    eps = 1e-10
+    p_probs = p_counts / p_counts.sum()
+    q_probs = q_counts / q_counts.sum()
+    p_ref = np.where(p_counts > 0, p_probs, eps)
+    q_ref = np.where(q_counts > 0, q_probs, eps)
+    expected = scipy.spatial.distance.jensenshannon(p_ref, q_ref)
+    assert float(js_distance(p_counts, q_counts)) == pytest.approx(float(expected), abs=1e-5)
+
+
+def test_demographic_parity_identical_groups_is_one():
+    recs = {"a": [["X", "Y"], ["Z"]], "b": [["X", "Y"], ["Z"]]}
+    score, details = demographic_parity(recs)
+    assert score == pytest.approx(1.0, abs=1e-6)
+    assert details["avg_divergence"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_demographic_parity_disjoint_groups_is_low():
+    recs = {"a": [["X", "Y"]], "b": [["Z", "W"]]}
+    score, _ = demographic_parity(recs)
+    # Fully disjoint distributions -> JS distance ~ sqrt(ln 2) ~ 0.8326
+    assert score == pytest.approx(1 - np.sqrt(np.log(2)), abs=1e-3)
+
+
+def test_individual_fairness_jaccard():
+    pairs = [("p1", "p2"), ("p1", "p3")]
+    recs = {"p1": ["A", "B", "C"], "p2": ["A", "B", "C"], "p3": ["D"]}
+    score, sims = individual_fairness(pairs, recs)
+    assert sims[0] == pytest.approx(1.0)
+    assert sims[1] == pytest.approx(0.0)
+    assert score == pytest.approx(0.5)
+
+
+def test_individual_fairness_empty_pair_is_one():
+    score, sims = individual_fairness([("p1", "p2")], {"p1": [], "p2": []})
+    assert sims == [1.0]
+
+
+def test_equal_opportunity_variance_semantics():
+    recs = {"g1": [["A", "B"]], "g2": [["C", "D"]]}
+    score, by_group = equal_opportunity(recs, {"A", "C"})
+    # both groups: 1 unique hit / 2 recommended = 0.5 -> var 0 -> EO 1
+    assert by_group == {"g1": 0.5, "g2": 0.5}
+    assert score == pytest.approx(1.0)
+    score2, by_group2 = equal_opportunity(recs, {"A", "B"})
+    rates = np.array([1.0, 0.0])
+    assert score2 == pytest.approx(1 / (1 + rates.var()))
+
+
+def test_exposure_ratio_matches_manual():
+    ranked = ["m", "f", "m", "f"]
+    ratio, means = exposure_ratio(ranked)
+    exp = 1.0 / np.log2(np.arange(4) + 2)
+    m_mean = np.mean([exp[0], exp[2]])
+    f_mean = np.mean([exp[1], exp[3]])
+    assert means["m"] == pytest.approx(m_mean, abs=1e-4)
+    assert means["f"] == pytest.approx(f_mean, abs=1e-4)
+    assert ratio == pytest.approx(f_mean / m_mean, abs=1e-4)
+
+
+def test_exposure_single_group():
+    ratio, means = exposure_ratio(["m", "m"])
+    assert ratio == pytest.approx(1.0)
+
+
+def test_ndcg_matches_manual():
+    gt = {"item1": 5.0, "item2": 3.0, "item3": 1.0}
+    val = ndcg(["item1", "item2", "item3"], gt)
+    assert val == pytest.approx(1.0)
+    val2 = ndcg(["item3", "item2", "item1"], gt)
+    dcg = 1 / np.log2(2) + 3 / np.log2(3) + 5 / np.log2(4)
+    idcg = 5 / np.log2(2) + 3 / np.log2(3) + 1 / np.log2(4)
+    assert val2 == pytest.approx(dcg / idcg, abs=1e-5)
+
+
+def test_precision_recall_f1_coverage():
+    assert precision_at_k(["a", "b", "c"], {"a", "z"}, k=3) == pytest.approx(1 / 3)
+    assert recall_at_k(["a", "b", "c"], {"a", "z"}, k=3) == pytest.approx(0.5)
+    assert f1_score(0.5, 0.5) == pytest.approx(0.5)
+    assert f1_score(0.0, 0.0) == 0.0
+    assert catalog_coverage([["a"], ["b"], ["a"]], 4) == pytest.approx(50.0)
+
+
+def test_snsr_snsv():
+    neutral = ["A", "B", "C", "D"]
+    groups = {"male": ["A", "B", "C", "D"], "female": ["A", "B", "X", "Y"]}
+    snsr, snsv, sims = snsr_snsv(neutral, groups)
+    assert sims["male"] == pytest.approx(1.0)
+    assert sims["female"] == pytest.approx(2 / 6)
+    assert snsr == pytest.approx(1.0 - 2 / 6)
+    vals = np.array([1.0, 2 / 6])
+    assert snsv == pytest.approx(vals.std(), abs=1e-6)
