@@ -98,7 +98,10 @@ class Config:
         for name, settings in self.model_settings:
             if name == model_name:
                 return settings
-        return ModelSettings()
+        raise KeyError(
+            f"no decode settings for model '{model_name}'; "
+            f"known: {sorted(n for n, _ in self.model_settings)}"
+        )
 
     @property
     def sensitive_attributes(self) -> Dict[str, List[str]]:

@@ -64,7 +64,9 @@ def demographic_parity(
             flat.append(list(recs))
             owners.append(gi)
     if not flat:
-        return 0.0, {"divergences": [], "distributions": {}, "avg_divergence": 0.0}
+        # Reference semantics (utils.py:207-209): no comparable pairs -> avg
+        # divergence 0 -> parity 1.0 (vacuously fair), not 0.0.
+        return 1.0, {"divergences": [], "distributions": {}, "avg_divergence": 0.0}
 
     ids, vocab = encode_rec_lists(flat)
     per_list = count_matrix(ids, len(vocab))  # [N, V]
@@ -218,7 +220,9 @@ def exposure_ratio(
         return 1.0, {}
     groups = group_order or sorted(set(ranked_groups))
     gidx = {g: i for i, g in enumerate(groups)}
-    arr = np.array([gidx[g] for g in ranked_groups], dtype=np.int32)
+    # Labels outside group_order map to PAD and are ignored by the kernel rather
+    # than crashing the sweep (model output can contain unexpected groups).
+    arr = np.array([gidx.get(g, -1) for g in ranked_groups], dtype=np.int32)
     ratio, means = exposure_ratio_kernel(jnp.asarray(arr), len(groups))
     means = np.asarray(means)
     return float(ratio), {

@@ -1,0 +1,20 @@
+"""Model layer: Flax decoder-only transformer family.
+
+One configurable implementation (``transformer.py``) covers every family in
+``BASELINE.json.configs`` — Llama-3-8B/70B, Mistral-7B, Gemma-7B (RoPE + GQA +
+RMSNorm + gated MLP, with Mistral's sliding window and Gemma's embedding scaling)
+and GPT-2 (learned positions + LayerNorm + GELU MLP) — selected purely by
+``ModelConfig`` flags so there is exactly one forward path to shard, test, and
+optimize.
+"""
+
+from fairness_llm_tpu.models.configs import MODEL_CONFIGS, ModelConfig, get_model_config
+from fairness_llm_tpu.models.transformer import Transformer, init_params
+
+__all__ = [
+    "ModelConfig",
+    "MODEL_CONFIGS",
+    "get_model_config",
+    "Transformer",
+    "init_params",
+]

@@ -1,0 +1,103 @@
+"""Model-family configurations.
+
+Architecture hyperparameters for the open-weight families named in
+``BASELINE.json.configs`` (Llama-3-8B/70B, Mistral-7B, Gemma-7B, GPT-2-small)
+plus tiny test configs. All sizes are public-knowledge architecture constants.
+
+Flags rather than subclasses select family behavior:
+- ``pos_emb``: "rope" (llama/mistral/gemma) or "learned" (gpt2)
+- ``norm``: "rmsnorm" or "layernorm"
+- ``mlp``: "glu" (SwiGLU/GeGLU) or "mlp" (GPT-2's fc->gelu->proj)
+- ``embed_scale``: Gemma multiplies embeddings by sqrt(d_model)
+- ``sliding_window``: Mistral's local attention span
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    d_model: int
+    d_ff: int
+    head_dim: int
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    pos_emb: str = "rope"  # "rope" | "learned"
+    norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    mlp: str = "glu"  # "glu" | "mlp"
+    use_bias: bool = False  # biases on attention + MLP projections (gpt2 family)
+    activation: str = "silu"  # "silu" | "gelu" | "gelu_tanh"
+    embed_scale: bool = False  # gemma: scale embeddings by sqrt(d_model)
+    tie_embeddings: bool = False
+    sliding_window: Optional[int] = None  # mistral
+    eos_token_id: int = 2
+    pad_token_id: int = 0
+    dtype: str = "bfloat16"
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+MODEL_CONFIGS = {
+    # Tiny config for tests/CI: fast to init, exercises GQA + RoPE + GLU path.
+    "tiny-test": ModelConfig(
+        name="tiny-test", vocab_size=512, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=64, d_ff=128, head_dim=16, max_seq_len=256, eos_token_id=1,
+        dtype="float32",
+    ),
+    # Tiny GPT-2-style config: learned positions + layernorm + gelu MLP path.
+    "tiny-gpt2": ModelConfig(
+        name="tiny-gpt2", vocab_size=512, num_layers=2, num_heads=4, num_kv_heads=4,
+        d_model=64, d_ff=256, head_dim=16, max_seq_len=256, pos_emb="learned",
+        norm="layernorm", mlp="mlp", use_bias=True, activation="gelu_tanh",
+        tie_embeddings=True, eos_token_id=1, dtype="float32",
+    ),
+    "gpt2-small": ModelConfig(
+        name="gpt2-small", vocab_size=50257, num_layers=12, num_heads=12,
+        num_kv_heads=12, d_model=768, d_ff=3072, head_dim=64, max_seq_len=1024,
+        pos_emb="learned", norm="layernorm", mlp="mlp", use_bias=True,
+        activation="gelu_tanh", tie_embeddings=True, eos_token_id=50256,
+        pad_token_id=50256,
+    ),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b", vocab_size=128256, num_layers=32, num_heads=32,
+        num_kv_heads=8, d_model=4096, d_ff=14336, head_dim=128, max_seq_len=8192,
+        rope_theta=500000.0, eos_token_id=128001, pad_token_id=128001,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b", vocab_size=128256, num_layers=80, num_heads=64,
+        num_kv_heads=8, d_model=8192, d_ff=28672, head_dim=128, max_seq_len=8192,
+        rope_theta=500000.0, eos_token_id=128001, pad_token_id=128001,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32000, num_layers=32, num_heads=32,
+        num_kv_heads=8, d_model=4096, d_ff=14336, head_dim=128, max_seq_len=8192,
+        rope_theta=1000000.0, sliding_window=4096, eos_token_id=2, pad_token_id=0,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b", vocab_size=256000, num_layers=28, num_heads=16,
+        num_kv_heads=16, d_model=3072, d_ff=24576, head_dim=256, max_seq_len=8192,
+        activation="gelu_tanh", embed_scale=True, tie_embeddings=True,
+        eos_token_id=1, pad_token_id=0,
+    ),
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name not in MODEL_CONFIGS:
+        raise KeyError(f"unknown model '{name}'; available: {sorted(MODEL_CONFIGS)}")
+    return MODEL_CONFIGS[name]
