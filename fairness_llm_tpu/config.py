@@ -78,6 +78,7 @@ class Config:
     default_model_phase3: str = "tiny-test"
     model_settings: Tuple[Tuple[str, ModelSettings], ...] = (
         ("tiny-test", ModelSettings(temperature=0.7, max_tokens=128)),
+        ("tiny-gpt2", ModelSettings(temperature=0.7, max_tokens=128)),
         ("gpt2-small", ModelSettings(temperature=0.7, max_tokens=256)),
         ("llama3-8b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("llama3-70b", ModelSettings(temperature=0.7, max_tokens=500)),

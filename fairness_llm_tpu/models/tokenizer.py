@@ -1,0 +1,114 @@
+"""Tokenizers: a dependency-free byte-level tokenizer + an HF adapter.
+
+The reference never tokenizes — text goes to the OpenAI API verbatim
+(``phase1_bias_detection.py:180-188``). In-framework decode needs a tokenizer:
+
+- ``ByteTokenizer``: deterministic UTF-8 byte tokenizer with reserved specials.
+  Works for any model vocab >= 258, needs no downloaded files — this is what
+  tests, the simulated backend, and randomly initialized models use.
+- ``HFTokenizer``: thin adapter over a locally available ``transformers``
+  tokenizer directory (for real Llama/Mistral/Gemma/GPT-2 checkpoints). Never
+  touches the network (``local_files_only=True``).
+
+Both expose the same surface: ``encode_batch`` producing **left-padded** fixed
+shape ``[B, S]`` int32 arrays (left padding keeps the KV write index uniform
+across the batch — see ``models/transformer.py`` design notes) and ``decode``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TokenBatch:
+    """Left-padded prompt batch ready for prefill."""
+
+    tokens: np.ndarray  # [B, S] int32
+    valid: np.ndarray  # [B, S] bool (False on left pads)
+    lengths: np.ndarray  # [B] int32 real token counts
+
+
+def _left_pad(rows: Sequence[List[int]], pad_id: int, max_len: Optional[int] = None) -> TokenBatch:
+    n = len(rows)
+    s = max_len or max((len(r) for r in rows), default=1)
+    s = max(s, 1)
+    tokens = np.full((n, s), pad_id, dtype=np.int32)
+    valid = np.zeros((n, s), dtype=bool)
+    lengths = np.zeros((n,), dtype=np.int32)
+    for i, row in enumerate(rows):
+        row = row[-s:] if len(row) > s else row  # truncate from the left, keep recency
+        if row:
+            tokens[i, s - len(row):] = row
+            valid[i, s - len(row):] = True
+        lengths[i] = len(row)
+    return TokenBatch(tokens=tokens, valid=valid, lengths=lengths)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes -> ids with reserved specials.
+
+    Layout: 0=pad, 1=eos, 2=bos, bytes b -> 3+b. Total 259 ids; any model with
+    vocab_size >= 259 can host it (the tiny test configs use vocab 512).
+    """
+
+    PAD_ID = 0
+    EOS_ID = 1
+    BOS_ID = 2
+    OFFSET = 3
+
+    def __init__(self, vocab_size: int = 512):
+        if vocab_size < self.OFFSET + 256:
+            raise ValueError(f"vocab_size {vocab_size} < {self.OFFSET + 256}")
+        self.vocab_size = vocab_size
+        self.pad_id = self.PAD_ID
+        self.eos_id = self.EOS_ID
+        self.bos_id = self.BOS_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [self.OFFSET + b for b in text.encode("utf-8")]
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def encode_batch(self, texts: Sequence[str], max_len: Optional[int] = None) -> TokenBatch:
+        return _left_pad([self.encode(t) for t in texts], self.pad_id, max_len)
+
+
+class HFTokenizer:
+    """Adapter over a local HuggingFace tokenizer (no network)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.pad_id = self._tok.pad_token_id
+        if self.pad_id is None:
+            self.pad_id = self._tok.eos_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.bos_id = self._tok.bos_token_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        ids = [i for i in ids if i not in (self.pad_id, self.eos_id)]
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def encode_batch(self, texts: Sequence[str], max_len: Optional[int] = None) -> TokenBatch:
+        return _left_pad([self.encode(t) for t in texts], self.pad_id, max_len)
+
+
+def tokenizer_for(model_config, tokenizer_path: Optional[str] = None):
+    """Pick the tokenizer: HF if a local path is given, else byte-level."""
+    if tokenizer_path is not None:
+        return HFTokenizer(tokenizer_path)
+    return ByteTokenizer(model_config.vocab_size)

@@ -1,0 +1,25 @@
+"""Parallelism layer: device mesh, sharding rules, collectives.
+
+The reference has no parallelism of any kind (SURVEY.md §2: strictly sequential
+API loops). This layer is net-new TPU machinery: a ``("dp", "tp", "sp")``
+`jax.sharding.Mesh`, flax logical-axis rules mapping the model's named weight
+axes onto mesh axes, and helpers to shard params/batches. Scaling recipe follows
+the scaling-book pattern: pick a mesh, annotate shardings, let XLA insert the
+collectives.
+"""
+
+from fairness_llm_tpu.parallel.sharding import (
+    make_mesh,
+    make_axis_rules,
+    param_shardings,
+    shard_params,
+    batch_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "make_axis_rules",
+    "param_shardings",
+    "shard_params",
+    "batch_sharding",
+]

@@ -1,0 +1,113 @@
+"""Mesh construction + logical-axis -> mesh-axis sharding rules.
+
+The model (``models/transformer.py``) annotates every weight with *logical* axis
+names ("embed", "q_heads", "kv_heads", "ff", "vocab") and every activation with
+("batch", "seq", "embed"/"vocab"). This module decides how those logical axes map
+onto the physical ``("dp", "tp", "sp")`` mesh:
+
+- "batch"            -> "dp"   (the profile sweep is data-parallel)
+- "q_heads"/"kv_heads"/"ff"/"vocab" -> "tp"  (megatron-style tensor parallel:
+  column-parallel QKV/up projections, row-parallel o/down projections; XLA GSPMD
+  inserts the all-reduces the NCCL world would do by hand)
+- "seq"              -> "sp"   (sequence/context parallel for long prompts)
+- "embed"            -> replicated
+
+An axis is only mapped when its size divides the mesh axis (GQA models with few
+KV heads fall back to replicated KV, which is also what production TP serving
+does when kv_heads < tp).
+
+The reference has no equivalent — its "distributed backend" is HTTPS to OpenAI
+(SURVEY.md §5.8); this is the XLA-collectives-over-ICI replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fairness_llm_tpu.config import MeshConfig
+from fairness_llm_tpu.models.configs import ModelConfig
+
+AxisRules = Tuple[Tuple[str, Optional[str]], ...]
+
+
+def make_mesh(mesh_config: MeshConfig, devices: Optional[List] = None) -> Mesh:
+    """Build a ("dp", "tp", "sp") mesh over the available devices."""
+    devices = devices if devices is not None else jax.devices()
+    n = mesh_config.num_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {mesh_config.shape} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(mesh_config.shape)
+    # Auto axis types: we annotate weights/activations and let GSPMD propagate
+    # through gathers/scans (jax 0.9's Explicit mode would require per-gather
+    # out_sharding annotations inside the model).
+    axis_types = (jax.sharding.AxisType.Auto,) * len(mesh_config.axis_names)
+    return Mesh(arr, mesh_config.axis_names, axis_types=axis_types)
+
+
+def make_axis_rules(model_config: ModelConfig, mesh: Mesh) -> AxisRules:
+    """Logical->mesh axis rules, dropping mappings that don't divide evenly."""
+    tp = mesh.shape.get("tp", 1)
+    sp = mesh.shape.get("sp", 1)
+
+    def fits(size: int) -> bool:
+        return tp > 1 and size % tp == 0
+
+    rules = [
+        ("batch", "dp"),
+        ("seq", "sp" if sp > 1 else None),
+        ("embed", None),
+        ("q_heads", "tp" if fits(model_config.q_dim) else None),
+        ("kv_heads", "tp" if fits(model_config.kv_dim) else None),
+        ("ff", "tp" if fits(model_config.d_ff) else None),
+        ("vocab", "tp" if fits(model_config.vocab_size) else None),
+    ]
+    return tuple(rules)
+
+
+def param_shardings(model_config: ModelConfig, mesh: Mesh, rules: Optional[AxisRules] = None) -> Any:
+    """Pytree of NamedSharding for every model parameter.
+
+    Uses ``jax.eval_shape`` over ``model.init`` (no FLOPs, no memory) to recover
+    the logical partitioning metadata, then maps it through the axis rules.
+    """
+    from fairness_llm_tpu.models.transformer import Transformer
+
+    if rules is None:
+        rules = make_axis_rules(model_config, mesh)
+    model = Transformer(model_config)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    positions = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.key(0), tokens, positions)
+    specs = nn.get_partition_spec(abstract)["params"]
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, _resolve_spec(spec, rules)),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _resolve_spec(spec: P, rules: AxisRules) -> P:
+    table = dict(rules)
+    return P(*(table.get(axis) if axis is not None else None for axis in spec))
+
+
+def shard_params(params: Any, shardings: Any) -> Any:
+    """Place a (host or single-device) param pytree onto the mesh."""
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [B, ...] token batches: batch over dp, rest replicated."""
+    return NamedSharding(mesh, P("dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,14 @@
+"""TPU decode runtime: sampling, the batched autoregressive engine, weight IO.
+
+This layer replaces the reference's "model-inference client" — the
+``client.chat.completions.create`` calls inline in each phase driver
+(``phase1_bias_detection.py:180-188``, ``phase2_cross_model_eval.py:80-88``,
+``phase3_facter_mitigation.py:80-88``) — with in-framework sharded decode:
+prompts are tokenized, left-padded into one fixed-shape batch, prefic-filled
+once, then decoded with a single compiled ``lax.scan`` loop on device.
+"""
+
+from fairness_llm_tpu.runtime.engine import DecodeEngine, GenerateOutput
+from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
+
+__all__ = ["DecodeEngine", "GenerateOutput", "SamplerSettings", "make_sampler"]

@@ -1,0 +1,55 @@
+"""Jit-friendly token samplers: greedy / temperature / top-k / top-p.
+
+The reference delegates sampling to the OpenAI API (``temperature``/``max_tokens``
+knobs at ``phase1_bias_detection.py:186-187``). Here sampling is an on-device
+kernel: fixed-shape, no data-dependent control flow, composable with ``lax.scan``.
+Settings are static (baked into the compiled decode loop) — changing temperature
+recompiles, which is the right trade for a sweep that uses one setting for
+thousands of prompts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerSettings:
+    temperature: float = 0.7
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0  # 1.0 = disabled
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def make_sampler(settings: SamplerSettings) -> Callable[[jnp.ndarray, jax.Array], jnp.ndarray]:
+    """Build ``sample(logits[B, V], rng) -> tokens[B]`` for fixed settings."""
+
+    if settings.greedy:
+        return lambda logits, rng: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(logits: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+        x = logits.astype(jnp.float32) / settings.temperature
+        if settings.top_k > 0:
+            kth = jax.lax.top_k(x, settings.top_k)[0][..., -1:]
+            x = jnp.where(x < kth, -jnp.inf, x)
+        if settings.top_p < 1.0:
+            sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_x, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # Keep the smallest prefix with cumulative prob >= top_p (the token
+            # that crosses the threshold stays in — exclusive cumsum test).
+            keep_sorted = (cum - probs) < settings.top_p
+            cutoff = jnp.min(
+                jnp.where(keep_sorted, sorted_x, jnp.inf), axis=-1, keepdims=True
+            )
+            x = jnp.where(x < cutoff, -jnp.inf, x)
+        return jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+
+    return sample

@@ -8,10 +8,18 @@ tested without TPU hardware).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the session env pins JAX_PLATFORMS=axon (the live TPU tunnel)
+# and sitecustomize pre-imports jax, freezing that choice into jax.config — so
+# the env-var route alone is too late. Set XLA_FLAGS (read at CPU-client
+# creation, which hasn't happened yet) and flip the already-imported config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 
@@ -35,8 +43,9 @@ def reference_phase1_results():
 
 @pytest.fixture(scope="session")
 def eight_device_mesh():
-    import jax
-
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
-    return jax.make_mesh((2, 4), ("dp", "tp"))
+    from fairness_llm_tpu.config import MeshConfig
+    from fairness_llm_tpu.parallel import make_mesh
+
+    return make_mesh(MeshConfig(dp=2, tp=4, sp=1))

@@ -1,6 +1,8 @@
 """Model forward tests: cached vs uncached equivalence, padding invariance,
 family-flag paths (GPT-2-style, sliding window, GQA)."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -74,7 +76,7 @@ def test_left_padding_invariance():
 
 def test_sliding_window_changes_attention():
     base = get_model_config("tiny-test")
-    windowed = ModelConfig(**{**base.__dict__, "name": "tiny-swa", "sliding_window": 4})
+    windowed = dataclasses.replace(base, name="tiny-swa", sliding_window=4)
     params = init_params(base, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(3), (1, 12), 0, base.vocab_size)
     full = _forward_uncached(base, params, tokens)
