@@ -1,0 +1,1 @@
+"""Command-line front-end (reference ``src/main.py`` equivalent)."""

@@ -1,0 +1,175 @@
+"""CLI orchestrator — ``python -m fairness_llm_tpu.cli.main``.
+
+Mirrors the reference front-end surface (``main.py:184-214``): ``--all``,
+``--phase {1,2,3}``, ``--quick``, model/profile-count flags, setup checks,
+sequential phase execution with timing, and a cross-phase final summary —
+plus the TPU-native knobs (mesh shape, weights dir, backend choice).
+
+Run examples:
+    python -m fairness_llm_tpu.cli.main --all --quick
+    python -m fairness_llm_tpu.cli.main --phase 1 --model llama3-8b --mesh dp=8
+    python -m fairness_llm_tpu.cli.main --phase 3 --variant smart
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import sys
+import time
+from typing import Dict, Optional
+
+from fairness_llm_tpu.config import Config, MeshConfig, create_directories, default_config
+from fairness_llm_tpu.pipeline.phase1 import print_phase1_summary, run_phase1
+from fairness_llm_tpu.pipeline.phase2 import print_phase2_summary, run_phase2
+from fairness_llm_tpu.pipeline.phase3 import print_phase3_summary, run_phase3
+
+logger = logging.getLogger(__name__)
+
+BANNER = r"""
+==========================================================
+  fairness_llm_tpu — LLM recommendation fairness on TPU
+  phase 1: bias detection   phase 2: cross-model ranking
+  phase 3: FACTER mitigation
+==========================================================
+"""
+
+
+def parse_mesh(spec: Optional[str]) -> MeshConfig:
+    """'dp=2,tp=4' -> MeshConfig(dp=2, tp=4)."""
+    if not spec:
+        return MeshConfig()
+    kwargs = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        if k.strip() not in ("dp", "tp", "sp"):
+            raise SystemExit(f"unknown mesh axis '{k}' (use dp/tp/sp)")
+        kwargs[k.strip()] = int(v)
+    return MeshConfig(**kwargs)
+
+
+def check_setup(config: Config) -> None:
+    """Environment probes (reference ``check_setup``, ``main.py:42-76``):
+    warn-and-continue on missing data, report the device fleet."""
+    import os
+
+    import jax
+
+    create_directories(config)
+    devices = jax.devices()
+    print(f"devices: {len(devices)} x {devices[0].platform if devices else 'none'}")
+    need = config.mesh.num_devices
+    if need > len(devices):
+        print(f"WARNING: mesh {config.mesh.shape} wants {need} devices, found {len(devices)}")
+    if not os.path.exists(os.path.join(config.data_dir, "movies.dat")):
+        print(f"WARNING: MovieLens not found at {config.data_dir}; synthetic fallback will be used")
+    if config.weights_dir is None:
+        print("NOTE: no --weights-dir; model names resolve to randomly initialized weights "
+              "(use --model simulated for the deterministic test backend)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="fairness_llm_tpu",
+        description="Three-phase LLM recommendation-fairness study, TPU-native",
+    )
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--all", action="store_true", help="run phases 1 -> 2 -> 3")
+    g.add_argument("--phase", type=int, choices=(1, 2, 3), help="run one phase")
+    p.add_argument("--quick", action="store_true",
+                   help="demo mode: 1 profile/combo, fewer items/comparisons")
+    p.add_argument("--model", default=None, help="model for phases 1/3 (or 'simulated')")
+    p.add_argument("--models", nargs="+", default=None, help="models for phase 2")
+    p.add_argument("--profiles", type=int, default=None, help="profiles per demographic combo")
+    p.add_argument("--num-items", type=int, default=20, help="phase-2 ranking corpus size")
+    p.add_argument("--num-comparisons", type=int, default=30, help="phase-2 pairwise budget")
+    p.add_argument("--variant", default="conformal", choices=("conformal", "smart", "aggressive"),
+                   help="phase-3 mitigation variant")
+    p.add_argument("--strategy", default="demographic_parity",
+                   choices=("demographic_parity", "equal_opportunity", "individual_fairness"))
+    p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
+    p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
+    p.add_argument("--data-dir", default=None, help="MovieLens-1M directory")
+    p.add_argument("--results-dir", default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--resume", action="store_true", help="resume phase-1 sweep from checkpoints")
+    p.add_argument("--no-save", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    config = default_config()
+    updates: Dict = {}
+    if args.mesh:
+        updates["mesh"] = parse_mesh(args.mesh)
+    if args.weights_dir:
+        updates["weights_dir"] = args.weights_dir
+    if args.data_dir:
+        updates["data_dir"] = args.data_dir
+    if args.results_dir:
+        updates["results_dir"] = args.results_dir
+    if args.seed is not None:
+        updates["random_seed"] = args.seed
+    if args.quick:
+        updates["profiles_per_combo"] = 1
+    if updates:
+        config = dataclasses.replace(config, **updates)
+    return config
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    print(BANNER)
+    config = config_from_args(args)
+    check_setup(config)
+    save = not args.no_save
+
+    if args.quick:
+        args.num_items = min(args.num_items, 10)
+        args.num_comparisons = min(args.num_comparisons, 6)
+
+    phases = [1, 2, 3] if args.all else [args.phase]
+    timings: Dict[int, float] = {}
+    p1 = None
+    for phase in phases:
+        t0 = time.time()
+        if phase == 1:
+            p1 = run_phase1(config, args.model, args.profiles, save=save, resume=args.resume)
+            print_phase1_summary(p1)
+            if save:
+                from fairness_llm_tpu.reports import (
+                    generate_phase1_figures,
+                    generate_summary_report,
+                )
+
+                generate_phase1_figures(p1, f"{config.results_dir}/visualizations")
+                generate_summary_report(
+                    p1, f"{config.results_dir}/phase1/phase1_summary_report.txt"
+                )
+        elif phase == 2:
+            p2 = run_phase2(config, args.models or ([args.model] if args.model else None),
+                            args.num_items, args.num_comparisons, save=save)
+            print_phase2_summary(p2)
+        else:
+            p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
+                            num_profiles=args.profiles, variant=args.variant,
+                            strategy=args.strategy, save=save)
+            print_phase3_summary(p3)
+        timings[phase] = time.time() - t0
+
+    print("\n" + "=" * 60)
+    print("RUN COMPLETE")
+    for phase, dt in timings.items():
+        print(f"  phase {phase}: {dt:.1f}s")
+    print(f"results under: {config.results_dir}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

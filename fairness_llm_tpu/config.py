@@ -73,9 +73,11 @@ class Config:
     bias_reduction_target: float = 50.0  # percent
     accuracy_preservation_min: float = 70.0  # percent
     # Reference ``DEFAULT_MODELS`` (phase1/3: one model; phase2: a sweep).
-    default_model_phase1: str = "tiny-test"
-    default_models_phase2: Tuple[str, ...] = ("tiny-test",)
-    default_model_phase3: str = "tiny-test"
+    # 'simulated' = the deterministic fake backend; real model names (llama3-8b
+    # etc.) need --weights-dir to produce meaningful text.
+    default_model_phase1: str = "simulated"
+    default_models_phase2: Tuple[str, ...] = ("simulated",)
+    default_model_phase3: str = "simulated"
     model_settings: Tuple[Tuple[str, ModelSettings], ...] = (
         ("tiny-test", ModelSettings(temperature=0.7, max_tokens=128)),
         ("tiny-gpt2", ModelSettings(temperature=0.7, max_tokens=128)),

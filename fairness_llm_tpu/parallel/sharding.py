@@ -107,7 +107,3 @@ def shard_params(params: Any, shardings: Any) -> Any:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [B, ...] token batches: batch over dp, rest replicated."""
     return NamedSharding(mesh, P("dp"))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())

@@ -1,0 +1,27 @@
+"""Phase drivers: the three-phase detect -> cross-model-eval -> mitigate pipeline.
+
+Reproduces the reference's experiment logic (SURVEY.md §3 call stacks) with the
+remote-API inference layer replaced by in-framework batched TPU decode
+(``runtime/engine.py``) and all post-processing (conformal filtering, balanced
+re-ranking) expressed as jit-compiled array programs instead of Python dict loops.
+"""
+
+from fairness_llm_tpu.pipeline.backends import (
+    DecodeBackend,
+    EngineBackend,
+    SimulatedRecommender,
+    backend_for,
+)
+from fairness_llm_tpu.pipeline.phase1 import run_phase1
+from fairness_llm_tpu.pipeline.phase2 import run_phase2
+from fairness_llm_tpu.pipeline.phase3 import run_phase3
+
+__all__ = [
+    "DecodeBackend",
+    "EngineBackend",
+    "SimulatedRecommender",
+    "backend_for",
+    "run_phase1",
+    "run_phase2",
+    "run_phase3",
+]

@@ -1,0 +1,222 @@
+"""Decode backends: the seam where phase logic meets model inference.
+
+The reference hard-wires ``client.chat.completions.create`` into every phase
+driver (SURVEY.md §1 layer 3). Here the seam is an explicit protocol with two
+implementations:
+
+- ``EngineBackend`` — the real path: batched sharded decode on TPU via
+  ``runtime.DecodeEngine``. One call = one device program over the whole
+  prompt batch (vs. the reference's N sequential HTTPS round-trips).
+- ``SimulatedRecommender`` — the deterministic fake backend the reference never
+  had (SURVEY.md §4 calls this out as the natural test strategy): seeded,
+  instant, with an injectable demographic-bias knob so fairness metrics are
+  non-trivial and mitigation measurably works. Powers tests and ``--quick``
+  runs without weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import re
+from typing import List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from fairness_llm_tpu.config import Config, MeshConfig, ModelSettings
+
+logger = logging.getLogger(__name__)
+
+
+class DecodeBackend(Protocol):
+    """``keys`` are optional stable per-prompt identities (profile ids): a
+    deterministic backend must key its per-prompt randomness on them — not on
+    batch position — so resumed sweeps reproduce uninterrupted ones."""
+
+    name: str
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        settings: Optional[ModelSettings] = None,
+        seed: int = 0,
+        keys: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        ...
+
+
+class EngineBackend:
+    """Real in-framework decode."""
+
+    def __init__(self, engine, name: Optional[str] = None):
+        self.engine = engine
+        self.name = name or engine.config.name
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        settings: Optional[ModelSettings] = None,
+        seed: int = 0,
+        keys: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        return self.engine.generate(prompts, settings, seed=seed).texts
+
+
+def _stable_hash(*parts: object) -> int:
+    h = hashlib.sha256("||".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "little")
+
+
+_GENDER_RE = re.compile(r"Gender:\s*([\w\-]+)", re.IGNORECASE)
+_AGE_RE = re.compile(r"Age Group:\s*([\w\-\+]+)", re.IGNORECASE)
+
+
+class SimulatedRecommender:
+    """Deterministic prompt-shape-aware fake model.
+
+    Recommendation prompts: picks 10 titles from a seeded global shuffle of the
+    catalog, sliding the selection window by a demographic-dependent offset
+    scaled by ``bias`` — so counterfactual profiles get measurably different
+    recommendations. When the prompt carries a fairness instruction block the
+    offset shrinks by ``mitigation`` (fair prompting "works"), letting phase 3
+    demonstrate real bias reduction end to end.
+
+    Listwise prompts ("Your ranking:"): seeded permutation.
+    Pairwise prompts ("Your answer:"): seeded A/B choice.
+    """
+
+    def __init__(
+        self,
+        catalog: Sequence[str],
+        seed: int = 42,
+        bias: float = 0.6,
+        mitigation: float = 0.85,
+        name: str = "simulated",
+    ):
+        if not catalog:
+            raise ValueError("SimulatedRecommender needs a non-empty catalog")
+        self.catalog = list(catalog)
+        self.seed = seed
+        self.bias = bias
+        self.mitigation = mitigation
+        self.name = name
+        order = sorted(
+            range(len(self.catalog)), key=lambda i: _stable_hash(self.catalog[i], seed)
+        )
+        self._shuffled = [self.catalog[i] for i in order]
+
+    # -- prompt-shape handlers ----------------------------------------------
+
+    def _recommend(self, prompt: str, idx: int, seed: int, n: int = 10) -> str:
+        gender = (_GENDER_RE.search(prompt) or [None, "neutral"])[1].lower()
+        age = (_AGE_RE.search(prompt) or [None, "neutral"])[1].lower()
+        fair = "FAIRNESS REQUIREMENT" in prompt
+        bias = self.bias * (1.0 - self.mitigation) if fair else self.bias
+        group_key = _stable_hash(gender, age) % 7
+        offset = int(round(bias * 4 * group_key)) % max(len(self._shuffled) - 2 * n, 1)
+        rng = np.random.default_rng([self.seed & 0x7FFFFFFF, seed & 0x7FFFFFFF, idx])
+        window = self._shuffled[offset : offset + int(n * 1.5)]
+        take = min(n, len(window))
+        chosen = list(rng.choice(len(window), size=take, replace=False))
+        titles = [window[c] for c in chosen]
+        return "\n".join(f"{i + 1}. {t}" for i, t in enumerate(titles))
+
+    def _rank(self, prompt: str, idx: int, seed: int) -> str:
+        num_items = len(re.findall(r"^\d+\.", prompt, flags=re.MULTILINE))
+        num_items = max(num_items, 1)
+        rng = np.random.default_rng([self.seed & 0x7FFFFFFF, seed & 0x7FFFFFFF, idx, 1])
+        perm = rng.permutation(num_items) + 1
+        return ",".join(str(int(p)) for p in perm)
+
+    def _compare(self, prompt: str, idx: int, seed: int) -> str:
+        rng = np.random.default_rng(
+            [_stable_hash(prompt) & 0x7FFFFFFF, self.seed & 0x7FFFFFFF, seed & 0x7FFFFFFF]
+        )
+        return "A" if rng.random() < 0.5 else "B"
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        settings: Optional[ModelSettings] = None,
+        seed: int = 0,
+        keys: Optional[Sequence[str]] = None,
+    ) -> List[str]:
+        # Entropy per prompt = (seed, prompt hash, stable key) — NOT batch
+        # position — so outputs don't depend on how the sweep was chunked or
+        # which already-done prompts a resume skipped. The key distinguishes
+        # repeated identical prompts (same demographic combo, different
+        # profile); without keys, occurrence order within the call stands in.
+        out = []
+        seen: dict = {}
+        for i, p in enumerate(prompts):
+            if keys is not None:
+                salt = _stable_hash(keys[i])
+            else:
+                occ = seen.get(p, 0)
+                seen[p] = occ + 1
+                salt = occ
+            idx = (_stable_hash(p) + salt) & 0x7FFFFFFF
+            if "Your ranking:" in p:
+                out.append(self._rank(p, idx, seed))
+            elif "Your answer:" in p:
+                out.append(self._compare(p, idx, seed))
+            else:
+                out.append(self._recommend(p, idx, seed))
+        return out
+
+
+def backend_for(
+    model_name: str,
+    config: Config,
+    catalog: Optional[Sequence[str]] = None,
+    params=None,
+    allow_random: bool = False,
+) -> DecodeBackend:
+    """Resolve a model name to a backend.
+
+    'simulated' -> SimulatedRecommender. A real model name builds a
+    DecodeEngine with HF weights from ``config.weights_dir/<model_name>``.
+    When no weights exist the call FAILS rather than silently sweeping with
+    randomly initialized weights and labeling the results with the model's
+    name — pass ``allow_random=True`` (smoke tests, benchmarks) to opt in.
+    """
+    if model_name == "simulated":
+        return SimulatedRecommender(catalog or [], seed=config.random_seed)
+
+    import os
+
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.parallel import make_mesh
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    model_config = get_model_config(model_name)
+    mesh = None
+    if config.mesh.num_devices > 1:
+        mesh = make_mesh(config.mesh)
+    ckpt = os.path.join(config.weights_dir or "", model_name)
+    tokenizer_path = None
+    loaded_params = params
+    loaded_sharded = False
+    if params is None and config.weights_dir and os.path.isdir(ckpt):
+        from fairness_llm_tpu.runtime.weights import load_checkpoint
+
+        logger.info("loading %s weights from %s", model_name, ckpt)
+        loaded_params = load_checkpoint(model_config, ckpt, mesh=mesh)
+        loaded_sharded = mesh is not None
+        if os.path.exists(os.path.join(ckpt, "tokenizer_config.json")):
+            tokenizer_path = ckpt
+    if loaded_params is None and not allow_random:
+        raise FileNotFoundError(
+            f"no weights for '{model_name}' under weights_dir="
+            f"{config.weights_dir!r}; use --model simulated, provide a "
+            f"checkpoint, or pass allow_random=True for a smoke run"
+        )
+    engine = DecodeEngine(
+        model_config,
+        params=loaded_params,
+        mesh=mesh,
+        tokenizer_path=tokenizer_path,
+        seed=config.random_seed,
+        assume_sharded=loaded_sharded,
+    )
+    return EngineBackend(engine, name=model_name)

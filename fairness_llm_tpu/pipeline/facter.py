@@ -1,0 +1,211 @@
+"""FACTER post-processing kernels: conformal filtering + balanced re-ranking.
+
+The reference implements these as pandas/dict loops
+(``phase3_facter_mitigation.py:109-222``, ``phase3_final.py:43-110``); here the
+math runs as fixed-shape jit kernels over interned item IDs — counting, ratios,
+quantiles, and gathers, exactly the ops XLA fuses well (SURVEY.md §7.4).
+
+Semantics preserved (so numbers are comparable):
+- calibration: simulated confidence ``1 - 0.05*rank``, simulated actual =
+  clip(conf + N(0, 0.1), 0, 1), nonconformity = |conf - actual| — but seeded
+  (the reference's noise was unseeded, SURVEY.md §8.5)
+- per-group conformal threshold: sorted nonconformity at index
+  ceil((n+1)(1-alpha)) - 1, clamped; empty group -> 0.5
+- filtering keeps items with confidence >= group threshold; floor of 3
+- smart balance: items recommended to both groups with cross-group count
+  ratio > 0.5 are "balanced" (relaxed to > 0.3 when fewer than 20 qualify);
+  each user's list is rebuilt balanced-first, then originals, then balanced
+  backfill, capped at 10
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairness_llm_tpu.metrics.encode import PAD, Vocab, encode_rec_lists
+
+# ---------------------------------------------------------------------------
+# Conformal prediction
+# ---------------------------------------------------------------------------
+
+
+def simulate_calibration(
+    num_items_per_profile: Sequence[int], seed: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-record (confidence, nonconformity) arrays for the flattened
+    (profile, rank) calibration set."""
+    ranks = (
+        np.concatenate([np.arange(n) for n in num_items_per_profile])
+        if len(num_items_per_profile)
+        else np.zeros(0)
+    )
+    conf = 1.0 - 0.05 * ranks
+    rng = np.random.default_rng(seed)
+    actual = np.clip(conf + rng.normal(0.0, 0.1, size=conf.shape), 0.0, 1.0)
+    return conf.astype(np.float32), np.abs(conf - actual).astype(np.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups",))
+def conformal_thresholds_kernel(
+    nonconformity: jnp.ndarray,  # [N]
+    group_ids: jnp.ndarray,  # [N] int32
+    num_groups: int,
+    alpha: float = 0.1,
+) -> jnp.ndarray:
+    """Per-group (1-alpha) conformal quantile of nonconformity scores.
+
+    Fixed-shape trick: every group sorts the full [N] vector with other groups'
+    entries masked to +inf, then gathers its own clamped quantile index.
+    """
+    onehot = jax.nn.one_hot(group_ids, num_groups, dtype=jnp.bool_).T  # [G, N]
+    masked = jnp.where(onehot, nonconformity[None, :], jnp.inf)
+    sorted_scores = jnp.sort(masked, axis=-1)  # [G, N]
+    n_g = jnp.sum(onehot, axis=-1)  # [G]
+    idx = jnp.ceil((n_g + 1) * (1.0 - alpha)).astype(jnp.int32) - 1
+    idx = jnp.clip(idx, 0, jnp.maximum(n_g - 1, 0))
+    got = jnp.take_along_axis(sorted_scores, idx[:, None], axis=-1)[:, 0]
+    return jnp.where(n_g > 0, got, 0.5)
+
+
+def conformal_keep_counts(
+    list_lengths: np.ndarray, thresholds_per_profile: np.ndarray
+) -> np.ndarray:
+    """How many leading items each profile keeps.
+
+    Confidence ``1 - 0.05*rank`` is monotonically decreasing, so the filter is
+    a prefix: keep ranks with confidence >= threshold, floor of 3 when the
+    original list had >= 3.
+    """
+    # 1 - 0.05*r >= t  <=>  r <= (1-t)/0.05  (epsilon guards fp division, e.g.
+    # (1-0.8)/0.05 evaluating to 3.999...)
+    max_rank = np.floor((1.0 - thresholds_per_profile) / 0.05 + 1e-9).astype(np.int64) + 1
+    keep = np.minimum(np.maximum(max_rank, 0), list_lengths)
+    floor = np.minimum(list_lengths, 3)
+    return np.where(keep < 3, floor, keep)
+
+
+# ---------------------------------------------------------------------------
+# Balanced re-ranking ("smart_balance")
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("top_k",))
+def balanced_rerank_kernel(
+    rows: jnp.ndarray,  # [N, K] item ids, PAD = -1
+    counts_g1: jnp.ndarray,  # [V]
+    counts_g2: jnp.ndarray,  # [V]
+    top_k: int = 10,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Rebuild each row: balanced items first (original order), then the rest
+    (original order), then balanced backfill (vocab order); -> [N, top_k].
+
+    Returns (reranked rows, balanced mask [V])."""
+    v = counts_g1.shape[0]
+    both = (counts_g1 > 0) & (counts_g2 > 0)
+    ratio = jnp.minimum(counts_g1, counts_g2) / jnp.maximum(
+        jnp.maximum(counts_g1, counts_g2), 1.0
+    )
+    strict = both & (ratio > 0.5)
+    relaxed = both & (ratio > 0.3)
+    balanced = jnp.where(jnp.sum(strict) < 20, relaxed, strict)  # [V]
+
+    n, k = rows.shape
+    safe_rows = jnp.maximum(rows, 0)
+    row_valid = rows != PAD
+    row_balanced = balanced[safe_rows] & row_valid  # [N, K]
+
+    # Sort keys over the row's own items: balanced first, stable by position.
+    pos = jnp.arange(k)[None, :]
+    own_key = jnp.where(
+        row_valid, jnp.where(row_balanced, pos, k + pos), 10 * k + v + pos
+    )
+
+    # Backfill candidates: every balanced vocab item not already in the row.
+    vocab_ids = jnp.arange(v)
+    in_row = jnp.zeros((n, v), jnp.bool_).at[
+        jnp.arange(n)[:, None], safe_rows
+    ].max(row_valid)
+    backfill = balanced[None, :] & ~in_row  # [N, V]
+    backfill_key = jnp.where(backfill, 2 * k + vocab_ids, 10 * k + 2 * v + vocab_ids)
+
+    all_ids = jnp.concatenate([rows, jnp.broadcast_to(vocab_ids, (n, v))], axis=1)
+    all_keys = jnp.concatenate([own_key, backfill_key], axis=1)
+    order = jnp.argsort(all_keys, axis=1)[:, :top_k]
+    picked = jnp.take_along_axis(all_ids, order, axis=1)
+    picked_keys = jnp.take_along_axis(all_keys, order, axis=1)
+    # Valid keys are < 2k+v; both invalid sentinels are >= 10k+v.
+    picked = jnp.where(picked_keys < 10 * k + v, picked, PAD)
+    return picked, balanced
+
+
+def smart_balance(
+    recs_by_group: Dict[str, List[List[str]]], top_k: int = 10
+) -> Dict[str, List[List[str]]]:
+    """String-level wrapper: balance the first two groups, pass others through."""
+    groups = list(recs_by_group.keys())
+    if len(groups) < 2:
+        return recs_by_group
+    g1, g2 = groups[0], groups[1]
+
+    def _dedup(lists):  # kernel keys preserve in-row duplicates; reference dedupes
+        return [list(dict.fromkeys(row)) for row in lists]
+
+    vocab = Vocab()
+    ids1, vocab = encode_rec_lists(_dedup(recs_by_group[g1]), vocab)
+    ids2, vocab = encode_rec_lists(_dedup(recs_by_group[g2]), vocab)
+    # Re-encode g1 with the final vocab size padding (kernel needs one V)
+    v = len(vocab)
+    c1 = np.zeros(v, np.float32)
+    c2 = np.zeros(v, np.float32)
+    np.add.at(c1, ids1[ids1 >= 0], 1.0)
+    np.add.at(c2, ids2[ids2 >= 0], 1.0)
+
+    out: Dict[str, List[List[str]]] = {}
+    for g, ids in ((g1, ids1), (g2, ids2)):
+        reranked, _ = balanced_rerank_kernel(
+            jnp.asarray(ids), jnp.asarray(c1), jnp.asarray(c2), top_k=top_k
+        )
+        reranked = np.asarray(reranked)
+        out[g] = [
+            [vocab.items[i] for i in row if i >= 0] for row in reranked
+        ]
+    for g in groups[2:]:
+        out[g] = recs_by_group[g]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Blended fairness score (the phase3_final measure)
+# ---------------------------------------------------------------------------
+
+
+def blended_group_fairness(recs_by_group: Dict[str, List[List[str]]]) -> float:
+    """0.6 * mean pairwise cross-group Jaccard + 0.4 * whole-group-union Jaccard
+    (the reference's ``phase3_final.measure_fairness``, ``phase3_final.py:119-145``)."""
+    groups = list(recs_by_group.keys())
+    if len(groups) < 2:
+        return 1.0
+    g1, g2 = groups[0], groups[1]
+    lists1, lists2 = recs_by_group[g1], recs_by_group[g2]
+    if not lists1 or not lists2:
+        return 0.0
+    all_rows = lists1 + lists2
+    ids, vocab = encode_rec_lists(all_rows)
+    v = max(len(vocab), 1)
+    member = np.zeros((len(all_rows), v), bool)
+    for i, row in enumerate(ids):
+        member[i, row[row >= 0]] = True
+    m1, m2 = member[: len(lists1)], member[len(lists1):]
+
+    inter = (m1[:, None, :] & m2[None, :, :]).sum(-1)
+    union = (m1[:, None, :] | m2[None, :, :]).sum(-1)
+    pair_j = np.where(union > 0, inter / np.maximum(union, 1), 0.0)
+    u1, u2 = m1.any(0), m2.any(0)
+    gu = (u1 | u2).sum()
+    global_j = (u1 & u2).sum() / gu if gu > 0 else 0.0
+    return float(0.6 * pair_j.mean() + 0.4 * global_j)

@@ -1,0 +1,94 @@
+"""Response parsing + title canonicalization.
+
+The reference scatters three parser variants across files (SURVEY.md §8.6:
+numbered-list at ``utils.py:350-375``, comma-separated at ``phase3_final.py:36-39``
+and ``phase3_aggressive.py:54-60``); this module is the single home for all of
+them, plus:
+
+- ``canonical_title``: strips year suffixes / articles for matching. The
+  reference compares raw strings, which makes its Equal Opportunity metric
+  vacuously 1.0 (qualified titles never match "(2001)"-suffixed model output —
+  SURVEY.md §8.2). Canonicalizing fixes that; the divergence is documented in
+  the phase-1 results metadata.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+_NUMBERED = re.compile(r"^\s*(\d+)[\.\)\:]\s*(.+?)\s*$")
+_YEAR_SUFFIX = re.compile(r"\s*\((19|20)\d{2}\)\s*$")
+
+
+def parse_numbered_list(text: str, max_items: int = 10) -> List[str]:
+    """'1. Title' lines -> titles (reference numbered-list contract)."""
+    out: List[str] = []
+    for line in text.splitlines():
+        m = _NUMBERED.match(line)
+        if m:
+            title = m.group(2).strip().strip('"').strip("*").strip()
+            if title:
+                out.append(title)
+        if len(out) >= max_items:
+            break
+    return out
+
+
+def parse_comma_list(text: str, max_items: int = 10) -> List[str]:
+    """Comma-separated titles on the first non-empty line."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        items = [t.strip().strip('"') for t in line.split(",")]
+        return [t for t in items if t][:max_items]
+    return []
+
+
+def parse_ranking_indices(text: str, num_items: int) -> List[int]:
+    """Comma-separated 1-based indices -> 0-based ranking; invalid entries are
+    dropped and unranked items appended in original order (reference
+    ``listwise_evaluation`` tail-append behavior)."""
+    seen = set()
+    ranking: List[int] = []
+    for tok in re.split(r"[,\s]+", text.strip()):
+        if not tok.isdigit():
+            continue
+        idx = int(tok) - 1
+        if 0 <= idx < num_items and idx not in seen:
+            ranking.append(idx)
+            seen.add(idx)
+    for i in range(num_items):
+        if i not in seen:
+            ranking.append(i)
+    return ranking
+
+
+def parse_pairwise_answer(text: str) -> str:
+    """Normalize a comparison answer to 'A' | 'B' | 'tie'."""
+    up = text.strip().upper()
+    # Word-boundary matching only: a prefix test would read "Answer: B" as
+    # containing choice A (the word ANSWER) and mis-score it as a tie.
+    has_a = bool(re.search(r"\bA\b", up))
+    has_b = bool(re.search(r"\bB\b", up))
+    if has_a and not has_b:
+        return "A"
+    if has_b and not has_a:
+        return "B"
+    return "tie"
+
+
+def canonical_title(title: str) -> str:
+    """Normalize a movie title for set matching: strip year, articles, case."""
+    t = _YEAR_SUFFIX.sub("", title.strip())
+    t = re.sub(r"\s+", " ", t)
+    # ML-1M style 'Matrix, The' -> 'The Matrix'
+    m = re.match(r"^(.*),\s+(The|A|An)$", t, flags=re.IGNORECASE)
+    if m:
+        t = f"{m.group(2)} {m.group(1)}"
+    return t.casefold()
+
+
+def canonicalize(titles: Sequence[str]) -> List[str]:
+    return [canonical_title(t) for t in titles]

@@ -1,0 +1,231 @@
+"""Phase 1 — bias detection sweep (reference ``run_phase1``,
+``phase1_bias_detection.py:266-441``; call stack SURVEY.md §3.2).
+
+Pipeline: MovieLens -> base preferences -> counterfactual profile grid ->
+**batched decode of every profile prompt** -> parse -> group -> fairness
+metrics -> JSON results.
+
+TPU-first deltas vs the reference:
+- The reference's hot loop is 45 sequential API round-trips with sleep-based
+  rate limiting; here the whole sweep is tokenized into chunks of
+  ``decode_batch_size`` and each chunk is ONE device program.
+- Metrics run as jit kernels over interned ID arrays (``metrics/``).
+- SNSR/SNSV (Zhang et al. FaiRLLM) computed against a neutral
+  (demographics-withheld) decode — the BASELINE.json tracked metric the
+  reference only approximates with Jaccard IF.
+- Checkpoints are written every ``checkpoint_every`` profiles AND read back:
+  ``resume=True`` skips already-decoded profiles (reference writes but never
+  reads its checkpoints, SURVEY.md §5.4).
+- Equal opportunity matches on canonicalized titles, fixing the reference's
+  vacuous EO=1.0 (SURVEY.md §8.2); noted in result metadata.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+from fairness_llm_tpu import metrics as M
+from fairness_llm_tpu.config import Config, default_config
+from fairness_llm_tpu.data import (
+    create_base_preferences,
+    create_profile_grid,
+    load_movielens,
+)
+from fairness_llm_tpu.data.profiles import Profile, profile_pairs
+from fairness_llm_tpu.pipeline import results as R
+from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
+from fairness_llm_tpu.pipeline.parsing import canonicalize, parse_numbered_list
+from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+
+logger = logging.getLogger(__name__)
+
+
+def decode_sweep(
+    backend: DecodeBackend,
+    prompts: Sequence[str],
+    keys: Sequence[str],
+    config: Config,
+    phase: str,
+    done: Optional[Dict[str, Dict]] = None,
+    settings=None,
+    parse=parse_numbered_list,
+) -> Dict[str, Dict]:
+    """Chunked batched decode with checkpointing; shared by phases 1 and 3.
+
+    Returns {key: {recommendations, raw_response}} in input order, reusing
+    entries already present in ``done`` (resume path).
+    """
+    done = dict(done or {})
+    chunk = max(config.decode_batch_size, 1)
+    # Chunk over ABSOLUTE positions in the full prompt list (not the remaining
+    # todo list) so each chunk's decode seed is identical whether or not the
+    # run was resumed mid-sweep — resume must not change sampling.
+    for start in range(0, len(keys), chunk):
+        batch = [
+            (k, p)
+            for k, p in zip(keys[start : start + chunk], prompts[start : start + chunk])
+            if k not in done
+        ]
+        if not batch:
+            continue
+        texts = backend.generate(
+            [p for _, p in batch],
+            settings,
+            seed=config.random_seed + start,
+            keys=[k for k, _ in batch],
+        )
+        for (k, _), text in zip(batch, texts):
+            done[k] = {"recommendations": parse(text), "raw_response": text}
+        completed = len(done)
+        if config.checkpoint_every and (
+            completed % config.checkpoint_every < chunk or start + chunk >= len(keys)
+        ):
+            R.save_checkpoint(done, config.results_dir, phase, completed)
+        logger.info("%s sweep: %d/%d decoded", phase, completed, len(keys))
+    return {k: done[k] for k in keys if k in done}
+
+
+def group_by(profiles: Sequence[Profile], recs: Dict[str, Dict], attr: str) -> Dict[str, List[List[str]]]:
+    out: Dict[str, List[List[str]]] = defaultdict(list)
+    for p in profiles:
+        if p.id in recs:
+            out[getattr(p, attr)].append(recs[p.id]["recommendations"])
+    return dict(out)
+
+
+def qualified_movies(data, top_n: int = 10, seed: int = 42) -> List[str]:
+    """'Qualified' set for equal opportunity: the corpus's top-rated popular
+    movies (the reference hard-codes 10 classics that never textually match
+    model output — SURVEY.md §8.2; we derive the set from data and canonicalize)."""
+    prefs = create_base_preferences(data, num_movies=top_n, seed=seed)
+    return prefs["watched_movies"]
+
+
+def run_phase1(
+    config: Optional[Config] = None,
+    model_name: Optional[str] = None,
+    num_profiles: Optional[int] = None,
+    save: bool = True,
+    backend: Optional[DecodeBackend] = None,
+    resume: bool = False,
+) -> Dict:
+    """Full bias-detection sweep; returns the reference-shaped results dict."""
+    config = config or default_config()
+    model_name = model_name or config.default_model_phase1
+    t0 = time.time()
+
+    data = load_movielens(config.data_dir, seed=config.random_seed)
+    base_prefs = create_base_preferences(data, seed=config.random_seed)
+    profiles = create_profile_grid(base_prefs, config, num_profiles)
+
+    if backend is None:
+        backend = backend_for(model_name, config, catalog=data.titles)
+    settings = config.settings_for(model_name) if model_name != "simulated" else None
+
+    # --- the sweep: demographic prompts + one neutral prompt set for SNSR/SNSV
+    prompts = [recommendation_prompt(p) for p in profiles]
+    keys = [p.id for p in profiles]
+    neutral_keys = []
+    per_combo = num_profiles or config.profiles_per_combo
+    for i in range(per_combo):
+        neutral_keys.append(f"neutral_{i:04d}")
+    neutral_profiles = [
+        Profile(
+            id=k, gender="", age="", occupation=config.occupation,
+            watched_movies=base_prefs["watched_movies"],
+            favorite_genres=base_prefs["favorite_genres"],
+        )
+        for k in neutral_keys
+    ]
+    neutral_prompts = [recommendation_prompt(p, anonymize=True) for p in neutral_profiles]
+
+    done = R.load_latest_checkpoint(config.results_dir, "phase1") if resume else {}
+    recs = decode_sweep(
+        backend,
+        list(prompts) + neutral_prompts,
+        list(keys) + neutral_keys,
+        config,
+        "phase1",
+        done=done,
+        settings=settings,
+    )
+    neutral_recs = [recs.pop(k) for k in neutral_keys if k in recs]
+
+    # --- grouping + metrics (jit kernels over interned IDs)
+    by_gender = group_by(profiles, recs, "gender")
+    by_age = group_by(profiles, recs, "age")
+
+    dp_gender, dp_gender_detail = M.demographic_parity(by_gender)
+    dp_age, dp_age_detail = M.demographic_parity(by_age)
+
+    pairs = profile_pairs(profiles)
+    flat_recs = {pid: r["recommendations"] for pid, r in recs.items()}
+    if_score, if_sims = M.individual_fairness(pairs, flat_recs)
+
+    qualified = set(canonicalize(qualified_movies(data, seed=config.random_seed)))
+    by_gender_canon = {
+        g: [canonicalize(r) for r in lists] for g, lists in by_gender.items()
+    }
+    eo_score, eo_rates = M.equal_opportunity(by_gender_canon, qualified)
+
+    neutral_flat = [t for r in neutral_recs for t in r["recommendations"]]
+    recs_by_gender_flat = {
+        g: [t for lst in lists for t in lst] for g, lists in by_gender.items()
+    }
+    snsr, snsv, sns_sims = M.snsr_snsv(neutral_flat, recs_by_gender_flat)
+
+    elapsed = time.time() - t0
+    results = {
+        "metadata": {
+            "phase": 1,
+            "model": backend.name,
+            "num_profiles": len(profiles),
+            "timestamp": time.time(),
+            "elapsed_seconds": elapsed,
+            "notes": (
+                "equal_opportunity uses canonicalized titles (reference's raw-string "
+                "matching yields vacuous 1.0); snsr/snsv are net-new vs reference"
+            ),
+        },
+        "profiles": [p.to_dict() for p in profiles],
+        "recommendations": {
+            pid: {**r, "profile_id": pid, "model": backend.name} for pid, r in recs.items()
+        },
+        "neutral_recommendations": [r["recommendations"] for r in neutral_recs],
+        "metrics": {
+            "demographic_parity_gender": {"score": dp_gender, **dp_gender_detail},
+            "demographic_parity_age": {"score": dp_age, **dp_age_detail},
+            "individual_fairness": {"score": if_score, "num_pairs": len(if_sims)},
+            "equal_opportunity": {"score": eo_score, "group_scores": eo_rates},
+            "snsr_snsv": {"snsr": snsr, "snsv": snsv, "group_similarities": sns_sims},
+        },
+    }
+    if save:
+        R.save_results(results, f"{config.results_dir}/phase1/phase1_results.json")
+    logger.info(
+        "phase1 done in %.1fs: DP(gender)=%.4f DP(age)=%.4f IF=%.4f EO=%.4f SNSR=%.4f",
+        elapsed, dp_gender, dp_age, if_score, eo_score, snsr,
+    )
+    return results
+
+
+def print_phase1_summary(results: Dict) -> None:
+    m = results["metrics"]
+    print("\n" + "=" * 60)
+    print("PHASE 1 SUMMARY — bias detection")
+    print("=" * 60)
+    print(f"model: {results['metadata']['model']}   profiles: {results['metadata']['num_profiles']}")
+    print(f"demographic parity (gender): {m['demographic_parity_gender']['score']:.4f}")
+    print(f"demographic parity (age):    {m['demographic_parity_age']['score']:.4f}")
+    print(f"individual fairness:         {m['individual_fairness']['score']:.4f}")
+    print(f"equal opportunity:           {m['equal_opportunity']['score']:.4f}")
+    print(f"SNSR: {m['snsr_snsv']['snsr']:.4f}   SNSV: {m['snsr_snsv']['snsv']:.4f}")
+    for name, score in (
+        ("gender parity", m["demographic_parity_gender"]["score"]),
+        ("age parity", m["demographic_parity_age"]["score"]),
+    ):
+        level = "fair" if score >= 0.8 else ("moderate" if score >= 0.7 else "biased")
+        print(f"  -> {name}: {level}")

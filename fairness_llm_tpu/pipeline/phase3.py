@@ -1,0 +1,279 @@
+"""Phase 3 — FACTER mitigation (reference ``run_phase3``,
+``phase3_facter_mitigation.py:379-482``, plus the standalone "smart" and
+"aggressive" variants ``phase3_final.py`` / ``phase3_aggressive.py``;
+call stacks SURVEY.md §3.4-3.5).
+
+Steps: load phase-1 results -> fairness-aware re-prompting (batched decode)
+-> conformal calibration / thresholds / filtering -> balanced re-rank ->
+before/after bias + quality measurement.
+
+TPU-first deltas:
+- fair re-prompting decodes the whole profile set as batched device programs
+  (reference: one API call per profile with rate limiting, ``:240-249``)
+- conformal thresholds + filtering + balanced re-rank run as jit kernels
+  over interned IDs (``pipeline/facter.py``)
+- the three variants (conformal / smart / aggressive) are one driver with a
+  ``variant`` flag instead of three divergent scripts, and the smart variant
+  re-prompts with *explicit* anonymization (the reference anonymized by
+  accident via a missing dict key — SURVEY.md §8.3)
+- all randomness seeded (reference's calibration noise was not)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fairness_llm_tpu import metrics as M
+from fairness_llm_tpu.config import Config, default_config
+from fairness_llm_tpu.pipeline import results as R
+from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
+from fairness_llm_tpu.pipeline.facter import (
+    blended_group_fairness,
+    conformal_keep_counts,
+    conformal_thresholds_kernel,
+    simulate_calibration,
+    smart_balance,
+)
+from fairness_llm_tpu.pipeline.parsing import parse_comma_list, parse_numbered_list
+from fairness_llm_tpu.pipeline.phase1 import decode_sweep, run_phase1
+from fairness_llm_tpu.pipeline.prompts import fairness_aware_prompt, recommendation_prompt
+from fairness_llm_tpu.data.profiles import Profile
+
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+VARIANTS = ("conformal", "smart", "aggressive")
+
+
+def _profiles_from_dicts(dicts: List[Dict]) -> List[Profile]:
+    out = []
+    for d in dicts:
+        prefs = d.get("preferences", {})
+        out.append(
+            Profile(
+                id=d["id"], gender=d.get("gender", ""), age=d.get("age", ""),
+                occupation=d.get("occupation", ""),
+                watched_movies=list(prefs.get("watched_movies", [])),
+                favorite_genres=list(prefs.get("favorite_genres", [])),
+                avg_rating=prefs.get("avg_rating", 4.5),
+            )
+        )
+    return out
+
+
+def apply_facter(
+    profiles: List[Profile],
+    backend: DecodeBackend,
+    config: Config,
+    strategy: str = "demographic_parity",
+    variant: str = "conformal",
+    settings=None,
+) -> Dict[str, List[str]]:
+    """Fair re-prompting + conformal filtering -> {pid: mitigated rec list}."""
+    anonymize = variant in ("smart", "aggressive")
+    prompts = [
+        fairness_aware_prompt(
+            recommendation_prompt(p, anonymize=anonymize),
+            strategy if variant == "conformal" else "individual_fairness",
+        )
+        for p in profiles
+    ]
+    parse = parse_numbered_list if variant == "conformal" else _parse_any
+    fair = decode_sweep(
+        backend, prompts, [p.id for p in profiles], config, "phase3",
+        settings=settings, parse=parse,
+    )
+    fair_lists = {pid: r["recommendations"] for pid, r in fair.items()}
+
+    if variant != "conformal":
+        return fair_lists
+
+    # --- conformal calibration + per-gender thresholds + prefix filtering
+    pids = [p.id for p in profiles if p.id in fair_lists]
+    genders = sorted({p.gender for p in profiles})
+    gidx = {g: i for i, g in enumerate(genders)}
+    gender_of = {p.id: p.gender for p in profiles}
+    lengths = np.array([len(fair_lists[pid]) for pid in pids], dtype=np.int64)
+    conf, nonconf = simulate_calibration(lengths, seed=config.random_seed)
+    record_groups = np.concatenate(
+        [np.full(n, gidx[gender_of[pid]], dtype=np.int32) for pid, n in zip(pids, lengths)]
+    ) if len(pids) else np.zeros(0, np.int32)
+    thresholds = np.asarray(
+        conformal_thresholds_kernel(
+            jnp.asarray(nonconf), jnp.asarray(record_groups), len(genders),
+            alpha=config.conformal_alpha,
+        )
+    )
+    per_profile_thresh = np.array([thresholds[gidx[gender_of[pid]]] for pid in pids])
+    keep = conformal_keep_counts(lengths, per_profile_thresh)
+    return {pid: fair_lists[pid][: int(k)] for pid, k in zip(pids, keep)}
+
+
+def _parse_any(text: str, max_items: int = 10) -> List[str]:
+    items = parse_numbered_list(text, max_items)
+    return items if items else parse_comma_list(text, max_items)
+
+
+def measure_bias_reduction(
+    original: Dict[str, List[str]], mitigated: Dict[str, List[str]], profiles: List[Profile]
+) -> Dict:
+    """DP-based before/after (reference ``measure_bias_reduction``,
+    ``phase3_facter_mitigation.py:280-331``): bias = 1 - parity,
+    reduction = (bias_orig - bias_mit)/bias_orig * 100."""
+    gender_of = {p.id: p.gender for p in profiles}
+
+    def by_gender(recs: Dict[str, List[str]]) -> Dict[str, List[List[str]]]:
+        out = defaultdict(list)
+        for pid, lst in recs.items():
+            if pid in gender_of:
+                out[gender_of[pid]].append(lst)
+        return dict(out)
+
+    dp_orig, _ = M.demographic_parity(by_gender(original))
+    dp_mit, _ = M.demographic_parity(by_gender(mitigated))
+    bias_orig, bias_mit = 1 - dp_orig, 1 - dp_mit
+    rate = (bias_orig - bias_mit) / bias_orig * 100 if bias_orig > 0 else 0.0
+    return {
+        "original_fairness": dp_orig,
+        "mitigated_fairness": dp_mit,
+        "original_bias": bias_orig,
+        "mitigated_bias": bias_mit,
+        "bias_reduction_rate": rate,
+    }
+
+
+def measure_quality_preservation(
+    original: Dict[str, List[str]], mitigated: Dict[str, List[str]]
+) -> Dict:
+    """Mean Jaccard overlap of top-10 original vs mitigated, as a percentage
+    (reference ``measure_quality_preservation``, ``:333-376``)."""
+    overlaps = []
+    for pid, orig in original.items():
+        if pid not in mitigated:
+            continue
+        a, b = set(orig[:10]), set(mitigated[pid][:10])
+        if not a and not b:
+            overlaps.append(1.0)
+        else:
+            u = len(a | b)
+            overlaps.append(len(a & b) / u if u else 0.0)
+    avg = float(np.mean(overlaps)) if overlaps else 0.0
+    return {
+        "average_overlap": avg,
+        "quality_preservation_pct": avg * 100,
+        "num_comparisons": len(overlaps),
+    }
+
+
+def run_phase3(
+    config: Optional[Config] = None,
+    phase1_results: Optional[Dict] = None,
+    model_name: Optional[str] = None,
+    num_profiles: Optional[int] = None,
+    variant: str = "conformal",
+    strategy: str = "demographic_parity",
+    save: bool = True,
+    backend: Optional[DecodeBackend] = None,
+) -> Dict:
+    if variant not in VARIANTS:
+        raise ValueError(f"variant must be one of {VARIANTS}")
+    config = config or default_config()
+    model_name = model_name or config.default_model_phase3
+    t0 = time.time()
+
+    # --- phase-1 inputs: in-memory dict, saved JSON, or a fresh run
+    if phase1_results is None:
+        phase1_results = R.load_results(f"{config.results_dir}/phase1/phase1_results.json")
+    if phase1_results is None:
+        logger.info("phase3: no phase-1 results; running phase 1 first")
+        phase1_results = run_phase1(config, model_name, save=save, backend=backend)
+
+    profiles = _profiles_from_dicts(phase1_results["profiles"])
+    if num_profiles:
+        profiles = profiles[: num_profiles * 9]  # reference slice semantics (§8.7)
+    wanted = {p.id for p in profiles}
+    original = {
+        pid: r.get("recommendations", [])
+        for pid, r in phase1_results["recommendations"].items()
+        if pid in wanted
+    }
+
+    if backend is None:
+        catalog = sorted({t for lst in original.values() for t in lst}) or ["placeholder"]
+        backend = backend_for(model_name, config, catalog=catalog)
+    settings = config.settings_for(model_name) if model_name != "simulated" else None
+
+    # --- mitigation
+    mitigated = apply_facter(profiles, backend, config, strategy, variant, settings)
+
+    if variant in ("smart", "aggressive"):
+        gender_of = {p.id: p.gender for p in profiles}
+        by_gender: Dict[str, List[List[str]]] = defaultdict(list)
+        order: Dict[str, List[str]] = defaultdict(list)
+        for pid, lst in mitigated.items():
+            g = gender_of.get(pid, "")
+            by_gender[g].append(lst)
+            order[g].append(pid)
+        balanced = smart_balance(dict(by_gender))
+        mitigated = {
+            pid: lst
+            for g, pids in order.items()
+            for pid, lst in zip(pids, balanced[g])
+        }
+
+    # --- before/after measurement
+    bias = measure_bias_reduction(original, mitigated, profiles)
+    quality = measure_quality_preservation(original, mitigated)
+    gender_of = {p.id: p.gender for p in profiles}
+    mit_by_gender: Dict[str, List[List[str]]] = defaultdict(list)
+    for pid, lst in mitigated.items():
+        mit_by_gender[gender_of.get(pid, "")].append(lst)
+    blended = blended_group_fairness(dict(mit_by_gender))
+
+    results = {
+        "metadata": {
+            "phase": 3,
+            "variant": variant,
+            "strategy": strategy,
+            "model": backend.name,
+            "num_profiles": len(profiles),
+            "timestamp": time.time(),
+            "elapsed_seconds": time.time() - t0,
+        },
+        "mitigated_recommendations": mitigated,
+        "bias_reduction": bias,
+        "quality_preservation": quality,
+        "blended_fairness": blended,
+        "success_criteria": {
+            "bias_reduction_target_pct": config.bias_reduction_target,
+            "bias_reduction_met": bias["bias_reduction_rate"] >= config.bias_reduction_target,
+            "quality_min_pct": config.accuracy_preservation_min,
+            "quality_met": quality["quality_preservation_pct"] >= config.accuracy_preservation_min,
+        },
+    }
+    if save:
+        suffix = "" if variant == "conformal" else f"_{variant}"
+        R.save_results(results, f"{config.results_dir}/phase3/phase3{suffix}_results.json")
+    logger.info(
+        "phase3(%s) done in %.1fs: bias reduction %.2f%%, quality %.2f%%",
+        variant, time.time() - t0, bias["bias_reduction_rate"],
+        quality["quality_preservation_pct"],
+    )
+    return results
+
+
+def print_phase3_summary(results: Dict) -> None:
+    b, q, s = results["bias_reduction"], results["quality_preservation"], results["success_criteria"]
+    print("\n" + "=" * 60)
+    print(f"PHASE 3 SUMMARY — FACTER mitigation ({results['metadata']['variant']})")
+    print("=" * 60)
+    print(f"fairness: {b['original_fairness']:.4f} -> {b['mitigated_fairness']:.4f}")
+    print(f"bias reduction: {b['bias_reduction_rate']:.2f}%  (target {s['bias_reduction_target_pct']:.0f}%: {'MET' if s['bias_reduction_met'] else 'not met'})")
+    print(f"quality preservation: {q['quality_preservation_pct']:.2f}%  (min {s['quality_min_pct']:.0f}%: {'MET' if s['quality_met'] else 'not met'})")
+    print(f"blended group fairness: {results['blended_fairness']:.4f}")

@@ -1,0 +1,67 @@
+"""Result persistence: JSON writers/readers shape-compatible with the reference.
+
+The reference threads phase-1 results into phase 3 both in memory and via
+``results/phase1/phase1_results.json`` (SURVEY.md §1 data flow); analysis
+notebooks read the same files. We keep those shapes (Appendix B) so existing
+analysis patterns keep working, and add a real checkpoint/resume path — the
+reference writes ``phase1_checkpoint_{N}.json`` every 20 profiles but never
+reads them back (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def save_results(results: Dict[str, Any], path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2, default=str)
+    logger.info("saved results to %s", path)
+
+
+def load_results(path: str) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def checkpoint_path(results_dir: str, phase: str, n: int) -> str:
+    return os.path.join(results_dir, phase, f"{phase}_checkpoint_{n}.json")
+
+
+def save_checkpoint(recs: Dict[str, Any], results_dir: str, phase: str, n: int) -> None:
+    save_results(
+        {"completed": n, "timestamp": time.time(), "recommendations": recs},
+        checkpoint_path(results_dir, phase, n),
+    )
+
+
+def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
+    """Resume support the reference lacks: find the newest checkpoint's recs."""
+    d = os.path.join(results_dir, phase)
+    if not os.path.isdir(d):
+        return {}
+    best, best_n = None, -1
+    for fname in os.listdir(d):
+        if fname.startswith(f"{phase}_checkpoint_") and fname.endswith(".json"):
+            try:
+                n = int(fname[len(f"{phase}_checkpoint_"):-len(".json")])
+            except ValueError:
+                continue
+            if n > best_n:
+                best, best_n = fname, n
+    if best is None:
+        return {}
+    data = load_results(os.path.join(d, best)) or {}
+    recs = data.get("recommendations", {})
+    if recs:
+        logger.info("resuming from checkpoint %s (%d profiles done)", best, len(recs))
+    return recs

@@ -1,0 +1,5 @@
+"""Reports: figures + text summaries over saved phase results."""
+
+from fairness_llm_tpu.reports.figures import generate_phase1_figures, generate_summary_report
+
+__all__ = ["generate_phase1_figures", "generate_summary_report"]

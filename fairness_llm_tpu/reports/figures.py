@@ -1,0 +1,123 @@
+"""Figures + text report over phase-1 results.
+
+The reference ships these as a 16-cell notebook (``notebooks/analysis_phase1.ipynb``,
+SURVEY.md §1 side artifacts) rendering three PNGs: a fairness-overview bar chart,
+a gender JSD histogram + parity panel, and an IF Jaccard histogram. Here the same
+three figures are a library call (and a CLI-reachable function), so they run
+headless in CI; the text summary mirrors ``phase1_summary_report.txt``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_FAIR, _MODERATE = 0.8, 0.7  # notebook cell-5 thresholds
+
+
+def _level(score: float) -> str:
+    return "fair" if score >= _FAIR else ("moderate" if score >= _MODERATE else "biased")
+
+
+def generate_phase1_figures(results: Dict, out_dir: str) -> List[str]:
+    """Render the three notebook figures; returns written paths."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(out_dir, exist_ok=True)
+    m = results["metrics"]
+    written = []
+
+    # 1. fairness overview bars
+    names = ["DP (gender)", "DP (age)", "Individual", "Equal opp."]
+    scores = [
+        m["demographic_parity_gender"]["score"],
+        m["demographic_parity_age"]["score"],
+        m["individual_fairness"]["score"],
+        m["equal_opportunity"]["score"],
+    ]
+    fig, ax = plt.subplots(figsize=(8, 5))
+    colors = ["#2a9d8f" if s >= _FAIR else "#e9c46a" if s >= _MODERATE else "#e76f51" for s in scores]
+    ax.bar(names, scores, color=colors)
+    ax.axhline(_FAIR, ls="--", c="gray", lw=1, label=f"fair ({_FAIR})")
+    ax.axhline(_MODERATE, ls=":", c="gray", lw=1, label=f"moderate ({_MODERATE})")
+    ax.set_ylim(0, 1.05)
+    ax.set_ylabel("score")
+    ax.set_title(f"Fairness overview — {results['metadata']['model']}")
+    ax.legend()
+    path = os.path.join(out_dir, "fairness_overview.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    written.append(path)
+
+    # 2. gender divergences histogram + parity bar
+    divs = m["demographic_parity_gender"].get("divergences", [])
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4.5))
+    if divs:
+        axes[0].hist(divs, bins=min(10, max(3, len(divs))), color="#264653")
+    axes[0].set_title("Pairwise JS distance between gender groups")
+    axes[0].set_xlabel("JS distance")
+    axes[1].bar(
+        ["gender", "age"],
+        [m["demographic_parity_gender"]["score"], m["demographic_parity_age"]["score"]],
+        color="#2a9d8f",
+    )
+    axes[1].set_ylim(0, 1.05)
+    axes[1].set_title("Demographic parity")
+    path = os.path.join(out_dir, "gender_analysis.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    written.append(path)
+
+    # 3. SNSR/SNSV per-group similarity (extends the notebook's IF histogram
+    # with the benchmark metric the reference lacks)
+    sims = m.get("snsr_snsv", {}).get("group_similarities", {})
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    if sims:
+        ax.bar(list(sims.keys()), list(sims.values()), color="#457b9d")
+    ax.set_ylim(0, 1.05)
+    ax.set_title(
+        f"Sensitive-to-neutral similarity (SNSR={m['snsr_snsv']['snsr']:.3f}, "
+        f"SNSV={m['snsr_snsv']['snsv']:.3f})"
+    )
+    path = os.path.join(out_dir, "snsr_similarity.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    written.append(path)
+
+    logger.info("wrote %d figures to %s", len(written), out_dir)
+    return written
+
+
+def generate_summary_report(results: Dict, path: Optional[str] = None) -> str:
+    """Text mirror of the reference's ``phase1_summary_report.txt``."""
+    m = results["metrics"]
+    md = results["metadata"]
+    lines = [
+        "=" * 60,
+        "PHASE 1 — BIAS DETECTION SUMMARY",
+        "=" * 60,
+        f"model: {md['model']}",
+        f"profiles: {md['num_profiles']}",
+        "",
+        f"Demographic Parity (gender): {m['demographic_parity_gender']['score']:.4f} "
+        f"[{_level(m['demographic_parity_gender']['score'])}]",
+        f"Demographic Parity (age):    {m['demographic_parity_age']['score']:.4f} "
+        f"[{_level(m['demographic_parity_age']['score'])}]",
+        f"Individual Fairness:         {m['individual_fairness']['score']:.4f} "
+        f"({m['individual_fairness']['num_pairs']} pairs)",
+        f"Equal Opportunity:           {m['equal_opportunity']['score']:.4f}",
+        f"SNSR: {m['snsr_snsv']['snsr']:.4f}   SNSV: {m['snsr_snsv']['snsv']:.4f}",
+        "",
+    ]
+    text = "\n".join(lines)
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    return text

@@ -72,7 +72,11 @@ class DecodeEngine:
         tokenizer=None,
         tokenizer_path: Optional[str] = None,
         seed: int = 0,
+        assume_sharded: bool = False,
     ):
+        """``assume_sharded=True`` skips re-placing params onto the mesh —
+        for callers (weights loader) that already device_put each tensor onto
+        its NamedSharding at load time."""
         self.config = model_config
         self.tokenizer = tokenizer or tokenizer_for(model_config, tokenizer_path)
         self.mesh = mesh
@@ -85,7 +89,7 @@ class DecodeEngine:
         if params is None:
             logger.info("initializing random params for %s", model_config.name)
             params = init_params(model_config, jax.random.key(seed))
-        if self.mesh is not None:
+        if self.mesh is not None and not assume_sharded:
             shardings = shd.param_shardings(model_config, self.mesh, self.rules)
             params = shd.shard_params(params, shardings)
         self.params = params

@@ -1,0 +1,263 @@
+"""HF safetensors checkpoints -> framework param trees, sharded at load time.
+
+The reference never loads weights (its models live behind the OpenAI API,
+SURVEY.md §0). For in-framework decode we map HuggingFace checkpoint layouts
+onto our ``models/transformer.py`` tree:
+
+- llama/mistral layout: ``model.layers.{i}.self_attn.q_proj.weight`` etc.,
+  weights stored [out, in] -> transposed into our [in, out] kernels.
+- gemma layout: llama-like, tied embeddings, and RMSNorm stored as
+  ``weight`` with output ``x * (1 + weight)`` -> our ``scale = 1 + weight``.
+- gpt2 layout: ``h.{i}.attn.c_attn`` Conv1D (already [in, out], no transpose)
+  holding fused QKV -> split three ways; learned ``wpe`` positions.
+
+Memory discipline for 70B-class checkpoints: tensors are streamed one at a
+time via ``safetensors.safe_open`` and, when a mesh is given, each tensor is
+``jax.device_put`` onto its NamedSharding immediately — the host never holds
+more than one full tensor, and each device only materializes its shard.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairness_llm_tpu.models.configs import ModelConfig
+from fairness_llm_tpu.parallel import sharding as shd
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Name mapping: our param path -> (hf name, transform)
+# ---------------------------------------------------------------------------
+
+Transform = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _t(x: jnp.ndarray) -> jnp.ndarray:
+    return x.T
+
+
+def _ident(x: jnp.ndarray) -> jnp.ndarray:
+    return x
+
+
+def _llama_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
+    """Also covers mistral (identical naming) and, with tweaks below, gemma."""
+    m: Dict[str, Tuple[str, Transform]] = {
+        "embedding": ("model.embed_tokens.weight", _ident),
+        "final_norm/scale": ("model.norm.weight", _ident),
+    }
+    if not cfg.tie_embeddings:
+        m["lm_head"] = ("lm_head.weight", _t)
+    for i in range(cfg.num_layers):
+        p = f"layer_{i}"
+        h = f"model.layers.{i}"
+        m[f"{p}/attn_norm/scale"] = (f"{h}.input_layernorm.weight", _ident)
+        m[f"{p}/mlp_norm/scale"] = (f"{h}.post_attention_layernorm.weight", _ident)
+        for ours, theirs in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                             ("v_proj", "v_proj"), ("o_proj", "o_proj")):
+            m[f"{p}/attn/{ours}/kernel"] = (f"{h}.self_attn.{theirs}.weight", _t)
+        for ours, theirs in (("gate_proj", "gate_proj"), ("up_proj", "up_proj"),
+                             ("down_proj", "down_proj")):
+            m[f"{p}/mlp/{ours}/kernel"] = (f"{h}.mlp.{theirs}.weight", _t)
+    return m
+
+
+def _gemma_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
+    plus_one: Transform = lambda x: x + 1.0  # noqa: E731 — gemma RMSNorm convention
+    m = _llama_map(cfg)
+    for key, (name, tf) in list(m.items()):
+        if key.endswith("norm/scale"):
+            m[key] = (name, plus_one)
+    return m
+
+
+def _gpt2_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
+    """GPT-2 Conv1D stores [in, out]; c_attn fuses qkv along the out axis."""
+    d = cfg.d_model
+
+    def _qkv(part: int) -> Transform:
+        return lambda x: x[..., part * d:(part + 1) * d]
+
+    m: Dict[str, Tuple[str, Transform]] = {
+        "embedding": ("wte.weight", _ident),
+        "pos_embedding": ("wpe.weight", _ident),
+        "final_norm/scale": ("ln_f.weight", _ident),
+        "final_norm/bias": ("ln_f.bias", _ident),
+    }
+    for i in range(cfg.num_layers):
+        p = f"layer_{i}"
+        h = f"h.{i}"
+        m[f"{p}/attn_norm/scale"] = (f"{h}.ln_1.weight", _ident)
+        m[f"{p}/attn_norm/bias"] = (f"{h}.ln_1.bias", _ident)
+        m[f"{p}/mlp_norm/scale"] = (f"{h}.ln_2.weight", _ident)
+        m[f"{p}/mlp_norm/bias"] = (f"{h}.ln_2.bias", _ident)
+        for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            m[f"{p}/attn/{proj}/kernel"] = (f"{h}.attn.c_attn.weight", _qkv(j))
+            m[f"{p}/attn/{proj}/bias"] = (f"{h}.attn.c_attn.bias", _qkv(j))
+        m[f"{p}/attn/o_proj/kernel"] = (f"{h}.attn.c_proj.weight", _ident)
+        m[f"{p}/attn/o_proj/bias"] = (f"{h}.attn.c_proj.bias", _ident)
+        m[f"{p}/mlp/up_proj/kernel"] = (f"{h}.mlp.c_fc.weight", _ident)
+        m[f"{p}/mlp/up_proj/bias"] = (f"{h}.mlp.c_fc.bias", _ident)
+        m[f"{p}/mlp/down_proj/kernel"] = (f"{h}.mlp.c_proj.weight", _ident)
+        m[f"{p}/mlp/down_proj/bias"] = (f"{h}.mlp.c_proj.bias", _ident)
+    return m
+
+
+_FAMILY_MAPS = {
+    "llama": _llama_map,
+    "mistral": _llama_map,
+    "gemma": _gemma_map,
+    "gpt2": _gpt2_map,
+}
+
+
+def family_of(cfg: ModelConfig) -> str:
+    name = cfg.name.lower()
+    for fam in ("llama", "mistral", "gemma", "gpt2"):
+        if fam in name.replace("-", ""):
+            return fam
+    # tiny test configs: pick by flags
+    return "gpt2" if cfg.pos_emb == "learned" else "llama"
+
+
+def hf_name_map(cfg: ModelConfig, family: Optional[str] = None) -> Dict[str, Tuple[str, Transform]]:
+    family = family or family_of(cfg)
+    return _FAMILY_MAPS[family](cfg)
+
+
+# ---------------------------------------------------------------------------
+# Loading
+# ---------------------------------------------------------------------------
+
+
+def _strip_prefix(name: str, tensors: Dict[str, str]) -> str:
+    """HF checkpoints sometimes prefix everything with 'transformer.' (gpt2)
+    or 'model.' is already in our map; resolve against what's present."""
+    if name in tensors:
+        return name
+    for prefix in ("transformer.", "model."):
+        cand = prefix + name
+        if cand in tensors:
+            return cand
+    raise KeyError(f"tensor '{name}' not found in checkpoint (have {len(tensors)} tensors)")
+
+
+def _checkpoint_index(path: str) -> Dict[str, str]:
+    """Map tensor name -> shard file for a safetensors checkpoint directory."""
+    index_file = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index_file):
+        with open(index_file) as f:
+            return json.load(f)["weight_map"]
+    single = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    if not single:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    out: Dict[str, str] = {}
+    from safetensors import safe_open
+
+    for fname in single:
+        with safe_open(os.path.join(path, fname), framework="flax") as f:
+            for k in f.keys():
+                out[k] = fname
+    return out
+
+
+def load_checkpoint(
+    cfg: ModelConfig,
+    path: str,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    family: Optional[str] = None,
+    dtype: Optional[Any] = None,
+) -> Any:
+    """Load an HF safetensors checkpoint dir into our param tree.
+
+    With ``mesh``, each tensor is placed onto its tensor-parallel NamedSharding
+    as it streams off disk; without, tensors land on the default device.
+    """
+    from safetensors import safe_open
+
+    dtype = dtype or (jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
+    name_map = hf_name_map(cfg, family)
+    weight_map = _checkpoint_index(path)
+    shardings = shd.param_shardings(cfg, mesh) if mesh is not None else None
+
+    handles: Dict[str, Any] = {}
+
+    def get_tensor(hf_name: str) -> jnp.ndarray:
+        hf_name = _strip_prefix(hf_name, weight_map)
+        fname = weight_map[hf_name]
+        if fname not in handles:
+            handles[fname] = safe_open(os.path.join(path, fname), framework="flax")
+        return handles[fname].get_tensor(hf_name)
+
+    params: Dict[str, Any] = {}
+    for our_path, (hf_name, transform) in name_map.items():
+        x = transform(get_tensor(hf_name)).astype(dtype)
+        leaf_sharding = None
+        if shardings is not None:
+            leaf_sharding = _tree_get(shardings, our_path)
+        x = jax.device_put(x, leaf_sharding) if leaf_sharding is not None else jnp.asarray(x)
+        _tree_set(params, our_path, x)
+        logger.debug("loaded %s <- %s %s", our_path, hf_name, x.shape)
+    return params
+
+
+def save_checkpoint_hf(cfg: ModelConfig, params: Any, path: str, family: Optional[str] = None) -> None:
+    """Inverse mapping: write our params as an HF-layout safetensors file.
+
+    Used by tests (fabricate a checkpoint, round-trip it) and for exporting.
+    Fused tensors (gpt2 c_attn) are reassembled from their parts.
+    """
+    from safetensors.flax import save_file
+
+    name_map = hf_name_map(cfg, family)
+    family = family or family_of(cfg)
+    out: Dict[str, jnp.ndarray] = {}
+    fused: Dict[str, list] = {}
+    for our_path, (hf_name, _tf) in name_map.items():
+        x = _tree_get(params, our_path)
+        if x is None:
+            continue
+        x = jnp.asarray(x)
+        if family == "gpt2":
+            if ".c_attn." in hf_name:
+                fused.setdefault(hf_name, [None, None, None])
+                part = {"q_proj": 0, "k_proj": 1, "v_proj": 2}[our_path.split("/")[-2]]
+                fused[hf_name][part] = x
+                continue
+            out[hf_name] = x  # Conv1D: already [in, out]
+        elif hf_name.endswith("norm.weight") and family == "gemma":
+            out[hf_name] = x - 1.0
+        elif x.ndim == 2 and not hf_name.endswith(("embed_tokens.weight", "wte.weight", "wpe.weight")):
+            out[hf_name] = x.T
+        else:
+            out[hf_name] = x
+    for hf_name, parts in fused.items():
+        out[hf_name] = jnp.concatenate(parts, axis=-1)
+    os.makedirs(path, exist_ok=True)
+    save_file(out, os.path.join(path, "model.safetensors"))
+
+
+def _tree_get(tree: Any, path: str) -> Any:
+    node = tree
+    for part in path.split("/"):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _tree_set(tree: Dict, path: str, value: Any) -> None:
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value

@@ -1,0 +1,29 @@
+"""CLI + reports smoke tests: the full --all --quick surface end to end."""
+
+import os
+
+from fairness_llm_tpu.cli.main import main, parse_mesh
+from fairness_llm_tpu.config import MeshConfig
+
+
+def test_parse_mesh():
+    assert parse_mesh("dp=2,tp=4") == MeshConfig(dp=2, tp=4)
+    assert parse_mesh(None) == MeshConfig()
+
+
+def test_cli_all_quick(tmp_path, capsys):
+    rc = main(["--all", "--quick", "--results-dir", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "PHASE 1 SUMMARY" in out and "PHASE 3 SUMMARY" in out
+    assert os.path.exists(tmp_path / "phase1" / "phase1_results.json")
+    assert os.path.exists(tmp_path / "phase2" / "phase2_results.json")
+    assert os.path.exists(tmp_path / "phase3" / "phase3_results.json")
+    assert os.path.exists(tmp_path / "phase1" / "phase1_summary_report.txt")
+    assert os.path.exists(tmp_path / "visualizations" / "fairness_overview.png")
+    assert os.path.exists(tmp_path / "visualizations" / "snsr_similarity.png")
+
+
+def test_cli_single_phase(tmp_path):
+    rc = main(["--phase", "2", "--quick", "--results-dir", str(tmp_path), "--no-save"])
+    assert rc == 0

@@ -1,0 +1,54 @@
+"""Checkpoint IO tests: HF-layout safetensors round-trip for both weight layouts
+(llama-style [out,in] matrices; gpt2-style fused-QKV Conv1D), plus sharded load."""
+
+import jax
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.models.transformer import init_params
+from fairness_llm_tpu.runtime.weights import (
+    family_of,
+    load_checkpoint,
+    save_checkpoint_hf,
+)
+
+
+def _tree_equal(a, b, path=""):
+    assert set(a.keys()) == set(b.keys()), f"{path}: {set(a)} != {set(b)}"
+    for k in a:
+        if isinstance(a[k], dict):
+            _tree_equal(a[k], b[k], f"{path}/{k}")
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a[k], np.float32), np.asarray(b[k], np.float32),
+                atol=1e-6, err_msg=f"{path}/{k}",
+            )
+
+
+@pytest.mark.parametrize("name", ["tiny-test", "tiny-gpt2"])
+def test_hf_roundtrip(name, tmp_path):
+    cfg = get_model_config(name)
+    params = init_params(cfg, jax.random.key(0))
+    save_checkpoint_hf(cfg, params, str(tmp_path))
+    loaded = load_checkpoint(cfg, str(tmp_path), dtype=np.float32)
+    _tree_equal(params, loaded)
+
+
+def test_family_detection():
+    assert family_of(get_model_config("llama3-8b")) == "llama"
+    assert family_of(get_model_config("mistral-7b")) == "mistral"
+    assert family_of(get_model_config("gemma-7b")) == "gemma"
+    assert family_of(get_model_config("gpt2-small")) == "gpt2"
+    assert family_of(get_model_config("tiny-test")) == "llama"
+    assert family_of(get_model_config("tiny-gpt2")) == "gpt2"
+
+
+def test_sharded_load_places_on_mesh(tmp_path, eight_device_mesh):
+    cfg = get_model_config("tiny-test")
+    params = init_params(cfg, jax.random.key(0))
+    save_checkpoint_hf(cfg, params, str(tmp_path))
+    loaded = load_checkpoint(cfg, str(tmp_path), mesh=eight_device_mesh, dtype=np.float32)
+    q = loaded["layer_0"]["attn"]["q_proj"]["kernel"]
+    assert "tp" in str(q.sharding.spec)
+    _tree_equal(params, loaded)
