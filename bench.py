@@ -1,0 +1,99 @@
+"""Benchmark: phase-1 recommendation-sweep decode throughput per chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+What it measures: the end-to-end hot path of the study — the 45-profile
+counterfactual prompt sweep (SURVEY.md §3.2 hot loop) — as batched
+autoregressive decode on the local accelerator: tokenize -> left-pad ->
+prefill -> 128 scan decode steps -> detokenize. Model is gpt2-small
+(BASELINE.json configs[0]) with randomly initialized bf16 weights — weight
+values don't change FLOPs or memory traffic, so throughput is representative
+while requiring no checkpoint download.
+
+Baseline: the reference runs the same sweep as sequential OpenAI API calls —
+~15 min for 45 profiles per its README runtime estimate (SURVEY.md §6), i.e.
+0.05 profiles/sec. ``vs_baseline`` is the speedup over that.
+
+Run: python bench.py          (uses the default backend — TPU when present)
+     BENCH_MODEL=tiny-test python bench.py   (smoke on CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+REFERENCE_PROFILES_PER_SEC = 45 / (15 * 60)  # README estimate: 45 profiles / ~15 min
+MAX_NEW_TOKENS = 128
+
+
+def build_sweep_prompts():
+    from fairness_llm_tpu.config import default_config
+    from fairness_llm_tpu.data import (
+        create_base_preferences,
+        create_profile_grid,
+        load_movielens,
+    )
+    from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+
+    config = default_config()
+    data = load_movielens(config.data_dir, seed=config.random_seed)
+    prefs = create_base_preferences(data, seed=config.random_seed)
+    profiles = create_profile_grid(prefs, config)
+    return [recommendation_prompt(p) for p in profiles]
+
+
+def main() -> None:
+    from fairness_llm_tpu.config import ModelSettings
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-small")
+    config = get_model_config(model_name)
+    prompts = build_sweep_prompts()
+    settings = ModelSettings(temperature=0.7, top_k=0, top_p=1.0, max_tokens=MAX_NEW_TOKENS)
+
+    devices = jax.devices()
+    engine = DecodeEngine(config, seed=0)
+
+    # Warmup: compile prefill+decode for the sweep's bucketed shape.
+    engine.generate(prompts, settings, seed=0)
+
+    # Timed runs.
+    times = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        out = engine.generate(prompts, settings, seed=rep + 1)
+        jax.block_until_ready(out.tokens)
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    profiles_per_sec = len(prompts) / best
+    per_chip = profiles_per_sec / max(len(devices), 1) * len(devices)  # single program = 1 chip here
+    tokens_per_sec = len(prompts) * MAX_NEW_TOKENS / best
+
+    result = {
+        "metric": f"phase1_sweep_decode_throughput[{model_name},{devices[0].platform}]",
+        "value": round(profiles_per_sec, 3),
+        "unit": "profiles/sec/chip",
+        "vs_baseline": round(profiles_per_sec / REFERENCE_PROFILES_PER_SEC, 1),
+        "detail": {
+            "profiles": len(prompts),
+            "max_new_tokens": MAX_NEW_TOKENS,
+            "decode_tokens_per_sec": round(tokens_per_sec, 1),
+            "best_wall_s": round(best, 3),
+            "all_wall_s": [round(t, 3) for t in times],
+            "baseline": "reference README: ~15 min for the 45-profile sweep via API",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,12 @@ class EngineBackend:
         seed: int = 0,
         keys: Optional[Sequence[str]] = None,
     ) -> List[str]:
-        return self.engine.generate(prompts, settings, seed=seed).texts
+        row_seeds = None
+        if keys is not None:
+            # Per-row sampling streams keyed on stable identity, so resumed /
+            # re-chunked sweeps decode identical text for the same profile.
+            row_seeds = [(_stable_hash(k) ^ seed) & 0xFFFFFFFF for k in keys]
+        return self.engine.generate(prompts, settings, seed=seed, row_seeds=row_seeds).texts
 
 
 def _stable_hash(*parts: object) -> int:

@@ -27,7 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fairness_llm_tpu.metrics.encode import PAD, Vocab, encode_rec_lists
+from fairness_llm_tpu.metrics.encode import (
+    PAD,
+    Vocab,
+    count_matrix,
+    encode_rec_lists,
+    one_hot_membership,
+)
 
 # ---------------------------------------------------------------------------
 # Conformal prediction
@@ -158,12 +164,10 @@ def smart_balance(
     vocab = Vocab()
     ids1, vocab = encode_rec_lists(_dedup(recs_by_group[g1]), vocab)
     ids2, vocab = encode_rec_lists(_dedup(recs_by_group[g2]), vocab)
-    # Re-encode g1 with the final vocab size padding (kernel needs one V)
+    # One V across both groups (g1 rows were encoded before the vocab grew).
     v = len(vocab)
-    c1 = np.zeros(v, np.float32)
-    c2 = np.zeros(v, np.float32)
-    np.add.at(c1, ids1[ids1 >= 0], 1.0)
-    np.add.at(c2, ids2[ids2 >= 0], 1.0)
+    c1 = count_matrix(ids1, v).sum(axis=0)
+    c2 = count_matrix(ids2, v).sum(axis=0)
 
     out: Dict[str, List[List[str]]] = {}
     for g, ids in ((g1, ids1), (g2, ids2)):
@@ -196,10 +200,7 @@ def blended_group_fairness(recs_by_group: Dict[str, List[List[str]]]) -> float:
         return 0.0
     all_rows = lists1 + lists2
     ids, vocab = encode_rec_lists(all_rows)
-    v = max(len(vocab), 1)
-    member = np.zeros((len(all_rows), v), bool)
-    for i, row in enumerate(ids):
-        member[i, row[row >= 0]] = True
+    member = one_hot_membership(ids, max(len(vocab), 1))
     m1, m2 = member[: len(lists1)], member[len(lists1):]
 
     inter = (m1[:, None, :] & m2[None, :, :]).sum(-1)

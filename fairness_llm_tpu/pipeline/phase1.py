@@ -52,6 +52,7 @@ def decode_sweep(
     done: Optional[Dict[str, Dict]] = None,
     settings=None,
     parse=parse_numbered_list,
+    save_checkpoints: bool = True,
 ) -> Dict[str, Dict]:
     """Chunked batched decode with checkpointing; shared by phases 1 and 3.
 
@@ -80,7 +81,7 @@ def decode_sweep(
         for (k, _), text in zip(batch, texts):
             done[k] = {"recommendations": parse(text), "raw_response": text}
         completed = len(done)
-        if config.checkpoint_every and (
+        if save_checkpoints and config.checkpoint_every and (
             completed % config.checkpoint_every < chunk or start + chunk >= len(keys)
         ):
             R.save_checkpoint(done, config.results_dir, phase, completed)
@@ -151,6 +152,7 @@ def run_phase1(
         "phase1",
         done=done,
         settings=settings,
+        save_checkpoints=save,
     )
     neutral_recs = [recs.pop(k) for k in neutral_keys if k in recs]
 

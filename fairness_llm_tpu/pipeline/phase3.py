@@ -74,6 +74,7 @@ def apply_facter(
     strategy: str = "demographic_parity",
     variant: str = "conformal",
     settings=None,
+    save_checkpoints: bool = True,
 ) -> Dict[str, List[str]]:
     """Fair re-prompting + conformal filtering -> {pid: mitigated rec list}."""
     anonymize = variant in ("smart", "aggressive")
@@ -87,7 +88,7 @@ def apply_facter(
     parse = parse_numbered_list if variant == "conformal" else _parse_any
     fair = decode_sweep(
         backend, prompts, [p.id for p in profiles], config, "phase3",
-        settings=settings, parse=parse,
+        settings=settings, parse=parse, save_checkpoints=save_checkpoints,
     )
     fair_lists = {pid: r["recommendations"] for pid, r in fair.items()}
 
@@ -196,7 +197,13 @@ def run_phase3(
 
     profiles = _profiles_from_dicts(phase1_results["profiles"])
     if num_profiles:
-        profiles = profiles[: num_profiles * 9]  # reference slice semantics (§8.7)
+        # num_profiles means "per demographic combo"; this grid has
+        # len(genders) x len(age_groups) combos (the reference hard-coded x9
+        # for its 3x3 view of a 15-combo grid — SURVEY.md §8.7; a wrong
+        # multiplier here truncates to a single-gender subset and degenerates
+        # demographic parity).
+        combos = len(config.genders) * len(config.age_groups)
+        profiles = profiles[: num_profiles * combos]
     wanted = {p.id for p in profiles}
     original = {
         pid: r.get("recommendations", [])
@@ -210,7 +217,9 @@ def run_phase3(
     settings = config.settings_for(model_name) if model_name != "simulated" else None
 
     # --- mitigation
-    mitigated = apply_facter(profiles, backend, config, strategy, variant, settings)
+    mitigated = apply_facter(
+        profiles, backend, config, strategy, variant, settings, save_checkpoints=save
+    )
 
     if variant in ("smart", "aggressive"):
         gender_of = {p.id: p.gender for p in profiles}

@@ -75,15 +75,17 @@ def generate_phase1_figures(results: Dict, out_dir: str) -> List[str]:
     written.append(path)
 
     # 3. SNSR/SNSV per-group similarity (extends the notebook's IF histogram
-    # with the benchmark metric the reference lacks)
-    sims = m.get("snsr_snsv", {}).get("group_similarities", {})
+    # with the benchmark metric the reference lacks; guard fully — reference-
+    # shaped result JSONs have no snsr_snsv block)
+    sns = m.get("snsr_snsv", {})
+    sims = sns.get("group_similarities", {})
     fig, ax = plt.subplots(figsize=(8, 4.5))
     if sims:
         ax.bar(list(sims.keys()), list(sims.values()), color="#457b9d")
     ax.set_ylim(0, 1.05)
     ax.set_title(
-        f"Sensitive-to-neutral similarity (SNSR={m['snsr_snsv']['snsr']:.3f}, "
-        f"SNSV={m['snsr_snsv']['snsv']:.3f})"
+        f"Sensitive-to-neutral similarity (SNSR={sns.get('snsr', float('nan')):.3f}, "
+        f"SNSV={sns.get('snsv', float('nan')):.3f})"
     )
     path = os.path.join(out_dir, "snsr_similarity.png")
     fig.savefig(path, dpi=120, bbox_inches="tight")
@@ -112,9 +114,11 @@ def generate_summary_report(results: Dict, path: Optional[str] = None) -> str:
         f"Individual Fairness:         {m['individual_fairness']['score']:.4f} "
         f"({m['individual_fairness']['num_pairs']} pairs)",
         f"Equal Opportunity:           {m['equal_opportunity']['score']:.4f}",
-        f"SNSR: {m['snsr_snsv']['snsr']:.4f}   SNSV: {m['snsr_snsv']['snsv']:.4f}",
-        "",
     ]
+    sns = m.get("snsr_snsv")
+    if sns:
+        lines.append(f"SNSR: {sns['snsr']:.4f}   SNSV: {sns['snsv']:.4f}")
+    lines.append("")
     text = "\n".join(lines)
     if path:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

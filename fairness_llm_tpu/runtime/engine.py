@@ -109,7 +109,7 @@ class DecodeEngine:
         pad_id = self.tokenizer.pad_id
         eos_id = self.tokenizer.eos_id
 
-        def run(params, tokens, valid, rng):
+        def run(params, tokens, valid, row_seeds):
             # positions: 0..len-1 over real tokens; pad slots clamped to 0
             positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
             cache = init_cache(cfg, batch, prompt_len + max_new)
@@ -117,10 +117,14 @@ class DecodeEngine:
                 {"params": params}, tokens, positions, valid, cache
             )
             last_logits = logits[:, -1, :]
+            # One independent key stream per row, derived from that row's seed
+            # alone — sampling must not depend on batch composition/position.
+            row_keys = jax.vmap(jax.random.key)(row_seeds)  # [B]
 
-            def step(carry, rng_step):
+            def step(carry, step_idx):
                 cache, prev_logits, done = carry
-                tok = sample(prev_logits, rng_step)
+                step_keys = jax.vmap(jax.random.fold_in, (0, None))(row_keys, step_idx)
+                tok = sample(prev_logits, step_keys)
                 tok = jnp.where(done, pad_id, tok)
                 done_next = done | (tok == eos_id)
                 step_valid = ~done  # the just-sampled token is real iff row was live
@@ -134,9 +138,10 @@ class DecodeEngine:
                 )
                 return (cache, logits[:, -1, :], done_next), tok
 
-            rngs = jax.random.split(rng, max_new)
             done0 = jnp.zeros((batch,), jnp.bool_)
-            (_, _, _), toks = jax.lax.scan(step, (cache, last_logits, done0), rngs)
+            (_, _, _), toks = jax.lax.scan(
+                step, (cache, last_logits, done0), jnp.arange(max_new)
+            )
             return toks.T  # [B, max_new]
 
         fn = jax.jit(run)
@@ -151,8 +156,14 @@ class DecodeEngine:
         settings: Optional[ModelSettings] = None,
         max_new_tokens: Optional[int] = None,
         seed: int = 0,
+        row_seeds: Optional[Sequence[int]] = None,
     ) -> GenerateOutput:
-        """Decode a batch of prompts; returns detokenized continuations."""
+        """Decode a batch of prompts; returns detokenized continuations.
+
+        ``row_seeds`` (one per prompt) make each row's sampling independent of
+        batch composition: the same (prompt, row_seed, settings) decodes the
+        same text whatever else shares the batch. Default: seed + position.
+        """
         settings = settings or ModelSettings()
         max_new = settings.max_tokens if max_new_tokens is None else max_new_tokens
         sampler = SamplerSettings(
@@ -188,6 +199,16 @@ class DecodeEngine:
         # valid BOS-ish token so attention has something to normalize over.
         valid[n:, -1] = True
 
+        if row_seeds is None:
+            row_seeds_arr = np.asarray(
+                [seed * 1_000_003 + i for i in range(batch)], dtype=np.uint32
+            )
+        else:
+            if len(row_seeds) != n:
+                raise ValueError(f"row_seeds has {len(row_seeds)} entries for {n} prompts")
+            row_seeds_arr = np.zeros(batch, dtype=np.uint32)
+            row_seeds_arr[:n] = np.asarray(row_seeds, dtype=np.uint64).astype(np.uint32)
+
         fn = self._decode_fn(batch, prompt_len, max_new, sampler)
         tokens_j = jnp.asarray(tokens)
         valid_j = jnp.asarray(valid)
@@ -199,12 +220,12 @@ class DecodeEngine:
         else:
             ctx_mesh = None
 
-        rng = jax.random.key(seed)
+        seeds_j = jnp.asarray(row_seeds_arr)
         if ctx_mesh is not None:
             with ctx_mesh, nn.logical_axis_rules(self.rules):
-                out = fn(self.params, tokens_j, valid_j, rng)
+                out = fn(self.params, tokens_j, valid_j, seeds_j)
         else:
-            out = fn(self.params, tokens_j, valid_j, rng)
+            out = fn(self.params, tokens_j, valid_j, seeds_j)
         out = np.asarray(jax.device_get(out))[:n]
 
         texts = []

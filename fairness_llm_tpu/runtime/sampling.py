@@ -29,12 +29,16 @@ class SamplerSettings:
 
 
 def make_sampler(settings: SamplerSettings) -> Callable[[jnp.ndarray, jax.Array], jnp.ndarray]:
-    """Build ``sample(logits[B, V], rng) -> tokens[B]`` for fixed settings."""
+    """Build ``sample(logits[B, V], row_rngs[B]) -> tokens[B]``.
+
+    Each batch row samples with its OWN key: a row's tokens must not depend on
+    which other prompts share the batch (resume/re-chunking reproducibility —
+    see ``pipeline/backends.py`` DecodeBackend contract)."""
 
     if settings.greedy:
-        return lambda logits, rng: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return lambda logits, row_rngs: jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    def sample(logits: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    def sample(logits: jnp.ndarray, row_rngs: jax.Array) -> jnp.ndarray:
         x = logits.astype(jnp.float32) / settings.temperature
         if settings.top_k > 0:
             kth = jax.lax.top_k(x, settings.top_k)[0][..., -1:]
@@ -50,6 +54,8 @@ def make_sampler(settings: SamplerSettings) -> Callable[[jnp.ndarray, jax.Array]
                 jnp.where(keep_sorted, sorted_x, jnp.inf), axis=-1, keepdims=True
             )
             x = jnp.where(x < cutoff, -jnp.inf, x)
-        return jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+        return jax.vmap(
+            lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
+        )(row_rngs, x)
 
     return sample

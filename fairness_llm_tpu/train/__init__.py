@@ -1,0 +1,12 @@
+"""Training: sharded next-token LM training step + loop.
+
+The reference trains nothing (all inference is remote API calls, SURVEY.md §0);
+this subsystem exists because a complete TPU framework must close the loop —
+fine-tuning the recommender models it serves. Design: functional TrainState,
+optax optimizer, one jitted step with (dp, tp, sp) shardings, optional
+rematerialization for memory.
+"""
+
+from fairness_llm_tpu.train.step import TrainState, make_train_step, train_loop
+
+__all__ = ["TrainState", "make_train_step", "train_loop"]

@@ -66,6 +66,19 @@ def test_sampled_decode_seed_reproducible(engine):
     assert not np.array_equal(out1.tokens, out3.tokens)
 
 
+def test_row_seeds_make_sampling_composition_independent(engine):
+    """With row_seeds, a prompt's sampled tokens must not depend on which other
+    prompts share the batch — the invariant resume/re-chunking relies on."""
+    settings = ModelSettings(temperature=0.9, max_tokens=10)
+    solo = engine.generate(["the quick brown fox"], settings, row_seeds=[123])
+    mixed = engine.generate(
+        ["padding prompt one", "the quick brown fox", "another row here"],
+        settings,
+        row_seeds=[7, 123, 9],
+    )
+    np.testing.assert_array_equal(solo.tokens[0], mixed.tokens[1])
+
+
 def test_sharded_decode_matches_unsharded(engine, eight_device_mesh):
     """dp=2 x tp=4 sharded decode reproduces single-device greedy output."""
     cfg = get_model_config("tiny-test")
