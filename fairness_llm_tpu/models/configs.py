@@ -42,6 +42,9 @@ class ModelConfig:
     eos_token_id: int = 2
     pad_token_id: int = 0
     dtype: str = "bfloat16"
+    # Pallas flash-attention for prefill/training attention on TPU (falls back
+    # to the XLA path off-TPU or when shapes don't meet the 128-lane tiling).
+    use_flash_attention: bool = True
 
     @property
     def q_dim(self) -> int:

@@ -131,6 +131,23 @@ def _activation(name: str):
 class Attention(nn.Module):
     config: ModelConfig
 
+    def _flash_ok(self, seq_len: int, left_padded: bool) -> bool:
+        """Static gate for the Pallas fast path.
+
+        Requires TPU, tile-compatible shapes, AND the caller's explicit promise
+        that batches are left-padded (the kernel reconstructs the padding mask
+        from a per-row length, which is only correct when valid tokens occupy
+        the trailing slots). The decode engine always left-pads; other callers
+        must opt in via ``left_padded=True``.
+        """
+        if not (self.config.use_flash_attention and left_padded) or seq_len <= 1:
+            return False
+        if jax.default_backend() != "tpu":
+            return False
+        from fairness_llm_tpu.ops import flash_supported
+
+        return flash_supported(seq_len, self.config.head_dim)
+
     @nn.compact
     def __call__(
         self,
@@ -140,6 +157,7 @@ class Attention(nn.Module):
         cache_index: Optional[jnp.ndarray],
         key_valid: jnp.ndarray,  # [B, K] for the post-update key set
         key_positions: jnp.ndarray,  # [B, K]
+        left_padded: bool = False,
     ):
         cfg = self.config
         dtype = _dtype_of(cfg)
@@ -161,38 +179,60 @@ class Attention(nn.Module):
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
 
+        # Shared cache write (prefill records the prompt for later decode steps).
         if cache_layer is not None:
             zero = jnp.zeros((), jnp.int32)
             keys = jax.lax.dynamic_update_slice(cache_layer.k, k.astype(dtype), (zero, cache_index, zero, zero))
             values = jax.lax.dynamic_update_slice(cache_layer.v, v.astype(dtype), (zero, cache_index, zero, zero))
             new_cache_layer = LayerCache(k=keys, v=values)
-            K = keys.shape[1]
-            # causal: new query i (global slot index+i) sees key slot j iff j <= index+i
-            j_idx = jnp.arange(K)[None, :]
-            q_idx = cache_index + jnp.arange(S)[:, None]
-            causal = j_idx <= q_idx  # [S, K]
         else:
             keys, values = k, v
             new_cache_layer = None
-            K = S
-            causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
-        allowed = causal[None, :, :] & key_valid[:, None, :]  # [B, S, K]
-        if cfg.sliding_window is not None:
-            delta = positions[:, :, None] - key_positions[:, None, :]
-            allowed = allowed & (delta < cfg.sliding_window)
+        if self._flash_ok(S, left_padded):
+            # Training (no cache) or first prefill (cache present but empty —
+            # S > 1 is the engine's static marker; a chunked-prefill caller
+            # must set use_flash_attention=False). In both cases the NEW k/v
+            # are the entire key set, so the kernel sees only [B, S].
+            from fairness_llm_tpu.ops import flash_attention
 
-        # GQA: repeat kv heads up to num_heads.
-        rep = cfg.num_heads // cfg.num_kv_heads
-        if rep > 1:
-            keys = jnp.repeat(keys, rep, axis=2)
-            values = jnp.repeat(values, rep, axis=2)
+            out = flash_attention(
+                q.transpose(0, 2, 1, 3),
+                k.astype(dtype).transpose(0, 2, 1, 3),
+                v.astype(dtype).transpose(0, 2, 1, 3),
+                jnp.sum(key_valid[:, :S], axis=1, dtype=jnp.int32),
+                causal=True,
+                window=cfg.sliding_window,
+            ).transpose(0, 2, 1, 3)
+        else:
+            if cache_layer is not None:
+                K = keys.shape[1]
+                # causal: new query i (global slot index+i) sees key slot j iff j <= index+i
+                j_idx = jnp.arange(K)[None, :]
+                q_idx = cache_index + jnp.arange(S)[:, None]
+                causal = j_idx <= q_idx  # [S, K]
+            else:
+                K = S
+                causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
 
-        scale = cfg.head_dim ** -0.5
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, keys).astype(jnp.float32) * scale
-        scores = jnp.where(allowed[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, values)
+            allowed = causal[None, :, :] & key_valid[:, None, :]  # [B, S, K]
+            if cfg.sliding_window is not None:
+                delta = positions[:, :, None] - key_positions[:, None, :]
+                allowed = allowed & (delta < cfg.sliding_window)
+
+            # GQA: repeat kv heads up to num_heads.
+            rep = cfg.num_heads // cfg.num_kv_heads
+            dense_keys, dense_values = keys, values
+            if rep > 1:
+                dense_keys = jnp.repeat(dense_keys, rep, axis=2)
+                dense_values = jnp.repeat(dense_values, rep, axis=2)
+
+            scale = cfg.head_dim ** -0.5
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, dense_keys).astype(jnp.float32) * scale
+            scores = jnp.where(allowed[:, None, :, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, dense_values)
+
         out = out.reshape(B, S, cfg.q_dim)
         out = nn.DenseGeneral(
             cfg.d_model,
@@ -232,10 +272,12 @@ class Block(nn.Module):
     config: ModelConfig
 
     @nn.compact
-    def __call__(self, x, positions, cache_layer, cache_index, key_valid, key_positions):
+    def __call__(self, x, positions, cache_layer, cache_index, key_valid, key_positions,
+                 left_padded=False):
         attn_out, new_cache = Attention(self.config, name="attn")(
             _norm(self.config, "attn_norm")(x),
             positions, cache_layer, cache_index, key_valid, key_positions,
+            left_padded=left_padded,
         )
         x = x + attn_out
         x = x + MLP(self.config, name="mlp")(_norm(self.config, "mlp_norm")(x))
@@ -266,6 +308,7 @@ class Transformer(nn.Module):
         positions: jnp.ndarray,  # [B, S] int32 (RoPE/learned positions, pad rows clamped)
         token_valid: Optional[jnp.ndarray] = None,  # [B, S] bool
         cache: Optional[KVCache] = None,
+        left_padded: bool = False,  # promise: valid tokens occupy trailing slots
     ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
         cfg = self.config
         dtype = _dtype_of(cfg)
@@ -311,7 +354,7 @@ class Transformer(nn.Module):
             x, new_layer = Block(cfg, name=f"layer_{i}")(
                 x, positions,
                 layer_cache, cache.index if cache is not None else None,
-                key_valid, key_positions,
+                key_valid, key_positions, left_padded=left_padded,
             )
             new_layers.append(new_layer)
 

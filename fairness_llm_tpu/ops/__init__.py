@@ -1,0 +1,10 @@
+"""Hand-written TPU kernels (Pallas) for the hot ops.
+
+XLA's fusions cover most of this framework; kernels live here only where
+hand-tiling beats the compiler — currently flash attention for the prefill
+phase (the O(S^2) op that dominates long-prompt sweeps).
+"""
+
+from fairness_llm_tpu.ops.flash_attention import flash_attention, flash_supported
+
+__all__ = ["flash_attention", "flash_supported"]

@@ -1,0 +1,141 @@
+"""Ring attention: exact attention over sequences sharded across the ``sp`` axis.
+
+Long-context machinery the reference (a remote-API pipeline with <=500-token
+prompts, SURVEY.md §5.7) never needed, but a TPU framework must have: when a
+sequence is too long for one chip's HBM, shard it over the mesh's ``sp`` axis
+and compute attention in ``sp`` ring steps. Each step a device:
+
+1. attends its LOCAL queries to the CURRENT k/v block (one MXU matmul pair),
+   folding results into an online-softmax accumulator (running max ``m``,
+   running denominator ``l``, unnormalized output ``o``), then
+2. passes its k/v block (and the block's positions/validity, needed for causal
+   and padding masks) to the next device over ICI via ``lax.ppermute``.
+
+After ``sp`` steps every query has seen every key exactly once — numerically
+identical to full attention (same fp32 softmax accumulation), with peak memory
+O(S·S/sp) and the k/v transfer overlapping compute around the ring.
+
+Use inside ``shard_map`` (see ``ring_attention_sharded``); single-device
+semantics (axis size 1) degenerate to ordinary attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(
+    q: jnp.ndarray,  # [B, Sq, H, D] (fp32)
+    k: jnp.ndarray,  # [B, Sk, H, D]
+    v: jnp.ndarray,  # [B, Sk, H, D]
+    mask: jnp.ndarray,  # [B, Sq, Sk] bool
+    scale: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One block's (scores-max, exp-sum, unnormalized out) for online softmax."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B, H, Sq]
+    # Rows with no visible key this block: keep accumulators neutral.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask[:, None, :, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B, H, Sq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_safe, l, o
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, Sq_local, H, D] this device's query block
+    k: jnp.ndarray,  # [B, Sk_local, H, D] this device's key block
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, Sq_local] global positions
+    kv_positions: jnp.ndarray,  # [B, Sk_local]
+    kv_valid: jnp.ndarray,  # [B, Sk_local] padding mask
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Exact sharded attention; call under ``shard_map`` with ``axis_name`` bound."""
+    axis_size = jax.lax.psum(1, axis_name)
+    scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32)
+
+    def mask_for(kpos, kval):
+        m = kval[:, None, :]
+        if causal:
+            m = m & (kpos[:, None, :] <= q_positions[:, :, None])
+        return m
+
+    def step(carry, _):
+        kb, vb, kpos, kval, m_acc, l_acc, o_acc = carry
+        m_blk, l_blk, o_blk = _block_attn(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+            mask_for(kpos, kval), scale,
+        )
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m_blk)
+        a = jnp.exp(m_acc - m_new)
+        b = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a + l_blk * b
+        o_new = o_acc * a.transpose(0, 2, 1)[..., None] + o_blk * b.transpose(0, 2, 1)[..., None]
+        # rotate k/v (+ their masks) one hop around the ring
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        kb, vb, kpos, kval = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (kb, vb, kpos, kval)
+        )
+        return (kb, vb, kpos, kval, m_new, l_new, o_new), None
+
+    B, Sq, H, D = q.shape
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    carry = (k, v, kv_positions, kv_valid, m0, l0, o0)
+    (_, _, _, _, m, l, o), _ = jax.lax.scan(step, carry, None, length=axis_size)
+
+    denom = jnp.maximum(l.transpose(0, 2, 1)[..., None], 1e-30)
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    mesh: Mesh,
+    q: jnp.ndarray,  # [B, S, H, D] GLOBAL arrays
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, S]
+    valid: jnp.ndarray,  # [B, S]
+    causal: bool = True,
+) -> jnp.ndarray:
+    """shard_map wrapper: sequence over ``sp``, batch over ``dp``, heads over ``tp``."""
+    from jax import shard_map
+
+    specs_qkv = P("dp", "sp", "tp", None)
+    specs_seq = P("dp", "sp")
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal),
+        mesh=mesh,
+        in_specs=(specs_qkv, specs_qkv, specs_qkv, specs_seq, specs_seq, specs_seq),
+        out_specs=specs_qkv,
+        check_vma=False,
+    )
+    return fn(q, k, v, positions, positions, valid)
+
+
+def full_attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    positions: jnp.ndarray, valid: jnp.ndarray, causal: bool = True,
+) -> jnp.ndarray:
+    """Dense single-device attention with identical masking — test oracle."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    mask = valid[:, None, :]
+    if causal:
+        mask = mask & (positions[:, None, :] <= positions[:, :, None])
+    s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -197,13 +197,19 @@ def run_phase3(
 
     profiles = _profiles_from_dicts(phase1_results["profiles"])
     if num_profiles:
-        # num_profiles means "per demographic combo"; this grid has
-        # len(genders) x len(age_groups) combos (the reference hard-coded x9
-        # for its 3x3 view of a 15-combo grid — SURVEY.md §8.7; a wrong
-        # multiplier here truncates to a single-gender subset and degenerates
-        # demographic parity).
-        combos = len(config.genders) * len(config.age_groups)
-        profiles = profiles[: num_profiles * combos]
+        # num_profiles means "per demographic combo". The grid is ordered
+        # gender-major, so a prefix slice (the reference's [:n*9] at
+        # phase3_facter_mitigation.py:411, SURVEY.md §8.7) would select a
+        # single-gender subset and degenerate demographic parity — select the
+        # first n profiles of EACH (gender, age) combo instead.
+        taken: Dict[tuple, int] = defaultdict(int)
+        kept = []
+        for p in profiles:
+            combo = (p.gender, p.age)
+            if taken[combo] < num_profiles:
+                taken[combo] += 1
+                kept.append(p)
+        profiles = kept
     wanted = {p.id for p in profiles}
     original = {
         pid: r.get("recommendations", [])

@@ -114,7 +114,7 @@ class DecodeEngine:
             positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
             cache = init_cache(cfg, batch, prompt_len + max_new)
             logits, cache = model.apply(
-                {"params": params}, tokens, positions, valid, cache
+                {"params": params}, tokens, positions, valid, cache, left_padded=True
             )
             last_logits = logits[:, -1, :]
             # One independent key stream per row, derived from that row's seed
@@ -180,7 +180,15 @@ class DecodeEngine:
         prompt_budget = self.config.max_seq_len - max_new
         n = len(prompts)
         tb = self.tokenizer.encode_batch(prompts)
-        prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget))
+        # Bucket to 128 only when this model can actually take the Pallas flash
+        # path (head_dim tiling + TPU); otherwise 64 to halve prefill padding.
+        flash_eligible = (
+            self.config.use_flash_attention
+            and self.config.head_dim % 128 == 0
+            and jax.default_backend() == "tpu"
+        )
+        bucket = 128 if flash_eligible else 64
+        prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget), bucket)
         if prompt_len > prompt_budget:
             prompt_len = prompt_budget
         if tb.tokens.shape[1] > prompt_len:

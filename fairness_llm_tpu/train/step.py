@@ -59,9 +59,9 @@ def make_train_step(
 ) -> Tuple[Callable, Callable]:
     """Build (init_state, train_step).
 
-    ``train_step(state, tokens, valid, rng) -> (state, loss)`` — jitted; when a
-    mesh is given, call it inside ``with mesh, nn.logical_axis_rules(rules):``
-    (or use ``train_loop`` which does this for you).
+    ``train_step(state, tokens, valid) -> (state, loss)`` — jitted and, when a
+    mesh is given, already wrapped in the mesh + logical-axis-rules context
+    (deterministic: no dropout, hence no rng argument).
     """
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     model = Transformer(model_config)

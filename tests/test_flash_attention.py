@@ -1,0 +1,51 @@
+"""Flash-attention kernel vs dense oracle (interpret mode — runs on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.ops import flash_attention, flash_supported
+
+
+def _dense(q, k, v, lengths, causal=True, window=None):
+    B, H, S, D = q.shape
+    rep = H // k.shape[1]
+    kk = jnp.repeat(k, rep, 1)
+    vv = jnp.repeat(v, rep, 1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kk) / np.sqrt(D)
+    ii = jnp.arange(S)[:, None]
+    jj = jnp.arange(S)[None, :]
+    mask = jj >= (S - lengths)[:, None, None, None]
+    if causal:
+        mask = mask & (jj <= ii)
+    if window is not None:
+        mask = mask & ((ii - jj) < window)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [dict(causal=True), dict(causal=False), dict(causal=True, window=64)],
+)
+def test_flash_matches_dense_interpret(kwargs):
+    B, H, Hkv, S, D = 2, 4, 2, 256, 128
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
+    lengths = jnp.asarray(np.array([S, S - 37], np.int32))
+    out = flash_attention(q, k, v, lengths, interpret=True, **kwargs)
+    ref = _dense(q, k, v, lengths, **kwargs)
+    valid_q = (jnp.arange(S)[None, :] >= (S - lengths)[:, None])[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(out * valid_q), np.asarray(ref * valid_q), atol=3e-5
+    )
+
+
+def test_flash_supported_gates():
+    assert flash_supported(256, 128)
+    assert not flash_supported(256, 64)  # gpt2 head_dim
+    assert not flash_supported(200, 128)  # non-multiple seq

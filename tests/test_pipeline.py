@@ -118,6 +118,22 @@ def test_phase3_variants(config, backend, variant):
     assert b["bias_reduction_rate"] > 0, f"{variant}: {b}"
 
 
+def test_phase3_num_profiles_is_stratified(config, backend):
+    """--profiles must take N per (gender, age) combo, not a gender-major
+    prefix (which would collapse demographic parity to one group)."""
+    p1 = run_phase1(config, model_name="simulated", backend=backend, save=False)
+    res = run_phase3(config, phase1_results=p1, model_name="simulated",
+                     backend=backend, num_profiles=1, save=False)
+    assert res["metadata"]["num_profiles"] == 15  # 3 genders x 5 ages x 1
+    genders = {pid.split("_")[0] for pid in res["mitigated_recommendations"]}
+    # all three genders represented among mitigated profiles
+    mit = res["mitigated_recommendations"]
+    from fairness_llm_tpu.pipeline.phase3 import _profiles_from_dicts
+
+    profs = {p.id: p for p in _profiles_from_dicts(p1["profiles"])}
+    assert {profs[pid].gender for pid in mit} == {"male", "female", "non-binary"}
+
+
 # ---------------------------------------------------------------------------
 # FACTER kernel unit tests
 # ---------------------------------------------------------------------------
