@@ -17,7 +17,6 @@ import argparse
 import dataclasses
 import logging
 import sys
-import time
 from typing import Dict, Optional
 
 from fairness_llm_tpu.config import Config, MeshConfig, create_directories, default_config
@@ -94,6 +93,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--results-dir", default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--resume", action="store_true", help="resume phase-1 sweep from checkpoints")
+    p.add_argument("--trace-dir", default=None,
+                   help="write a jax.profiler device trace per phase to this directory")
     p.add_argument("--no-save", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -112,6 +113,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["results_dir"] = args.results_dir
     if args.seed is not None:
         updates["random_seed"] = args.seed
+    if args.trace_dir:
+        updates["profile_trace_dir"] = args.trace_dir
     if args.quick:
         updates["profiles_per_combo"] = 1
     if updates:
@@ -134,39 +137,42 @@ def main(argv=None) -> int:
         args.num_items = min(args.num_items, 10)
         args.num_comparisons = min(args.num_comparisons, 6)
 
+    from fairness_llm_tpu.utils import maybe_trace, phase_timer
+
     phases = [1, 2, 3] if args.all else [args.phase]
-    timings: Dict[int, float] = {}
+    timings: Dict[str, float] = {}
     p1 = None
     for phase in phases:
-        t0 = time.time()
-        if phase == 1:
-            p1 = run_phase1(config, args.model, args.profiles, save=save, resume=args.resume)
-            print_phase1_summary(p1)
-            if save:
-                from fairness_llm_tpu.reports import (
-                    generate_phase1_figures,
-                    generate_summary_report,
-                )
+        with phase_timer(f"phase {phase}", timings), maybe_trace(
+            config.profile_trace_dir, f"phase{phase}"
+        ):
+            if phase == 1:
+                p1 = run_phase1(config, args.model, args.profiles, save=save, resume=args.resume)
+                print_phase1_summary(p1)
+                if save:
+                    from fairness_llm_tpu.reports import (
+                        generate_phase1_figures,
+                        generate_summary_report,
+                    )
 
-                generate_phase1_figures(p1, f"{config.results_dir}/visualizations")
-                generate_summary_report(
-                    p1, f"{config.results_dir}/phase1/phase1_summary_report.txt"
-                )
-        elif phase == 2:
-            p2 = run_phase2(config, args.models or ([args.model] if args.model else None),
-                            args.num_items, args.num_comparisons, save=save)
-            print_phase2_summary(p2)
-        else:
-            p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
-                            num_profiles=args.profiles, variant=args.variant,
-                            strategy=args.strategy, save=save)
-            print_phase3_summary(p3)
-        timings[phase] = time.time() - t0
+                    generate_phase1_figures(p1, f"{config.results_dir}/visualizations")
+                    generate_summary_report(
+                        p1, f"{config.results_dir}/phase1/phase1_summary_report.txt"
+                    )
+            elif phase == 2:
+                p2 = run_phase2(config, args.models or ([args.model] if args.model else None),
+                                args.num_items, args.num_comparisons, save=save)
+                print_phase2_summary(p2)
+            else:
+                p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
+                                num_profiles=args.profiles, variant=args.variant,
+                                strategy=args.strategy, save=save)
+                print_phase3_summary(p3)
 
     print("\n" + "=" * 60)
     print("RUN COMPLETE")
-    for phase, dt in timings.items():
-        print(f"  phase {phase}: {dt:.1f}s")
+    for name, dt in timings.items():
+        print(f"  {name}: {dt:.1f}s")
     print(f"results under: {config.results_dir}/")
     return 0
 

@@ -59,23 +59,34 @@ class MovieLensData:
 
 
 def _parse_dat(path: str, encoding: str = "latin-1") -> List[List[str]]:
-    """Parse a ``::``-separated .dat file into rows of string fields.
+    """Parse a ``::``-separated .dat file into rows of string fields (pure
+    Python — used for the small string tables like movies.dat)."""
+    rows = []
+    with open(path, "r", encoding=encoding) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if line:
+                rows.append(line.split("::"))
+    return rows
 
-    Uses the native C parser when available (fairness_llm_tpu.native), falling back
-    to pure Python.
-    """
+
+def _parse_ratings(path: str):
+    """Parse the 1M-row numeric ratings table: native C parser when available
+    (``fairness_llm_tpu/native``), pure Python otherwise."""
     try:
-        from fairness_llm_tpu.native import parse_dat_file  # C extension
+        from fairness_llm_tpu import native
 
-        return parse_dat_file(path, encoding)
-    except Exception:  # noqa: BLE001 — extension absent or failed; pure-python path
-        rows = []
-        with open(path, "r", encoding=encoding) as f:
-            for line in f:
-                line = line.rstrip("\n")
-                if line:
-                    rows.append(line.split("::"))
-        return rows
+        out = native.parse_ratings(path)
+        if out is not None:
+            return out
+    except Exception as e:  # noqa: BLE001 — never let the fast path break loading
+        logger.info("native ratings parse failed (%s); falling back", e)
+    rows = _parse_dat(path)
+    return (
+        np.array([int(r[0]) for r in rows], dtype=np.int32),
+        np.array([int(r[1]) for r in rows], dtype=np.int32),
+        np.array([float(r[2]) for r in rows], dtype=np.float32),
+    )
 
 
 def load_movielens(data_dir: str, allow_synthetic: bool = True, seed: int = 42) -> MovieLensData:
@@ -103,10 +114,7 @@ def load_movielens(data_dir: str, allow_synthetic: bool = True, seed: int = 42) 
     titles = [r[1] for r in movie_rows]
     genres = [r[2].split("|") for r in movie_rows]
 
-    rating_rows = _parse_dat(ratings_path)
-    r_users = np.array([int(r[0]) for r in rating_rows], dtype=np.int32)
-    r_movies = np.array([int(r[1]) for r in rating_rows], dtype=np.int32)
-    r_values = np.array([float(r[2]) for r in rating_rows], dtype=np.float32)
+    r_users, r_movies, r_values = _parse_ratings(ratings_path)
 
     logger.info("Loaded MovieLens: %d movies, %d ratings", len(movie_ids), len(r_values))
     return MovieLensData(movie_ids, titles, genres, r_users, r_movies, r_values)

@@ -1,0 +1,96 @@
+"""Native (C) data-path components, bound via ctypes.
+
+The reference has zero native code (SURVEY.md §2) and parses the 1M-row
+``ratings.dat`` with pandas' python engine; here the hot parse is ~50 lines of
+C compiled on first use (``cc -O3 -shared``) and cached next to the source.
+Everything degrades gracefully: if no compiler is available the callers fall
+back to the pure-Python parser (``data/movielens.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "parse_dat.c")
+_SO = os.path.join(_DIR, "_parse_dat.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the parser library; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                cc = os.environ.get("CC", "cc")
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True, capture_output=True, timeout=120,
+                )
+                logger.info("built native parser %s", _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.parse_ratings.restype = ctypes.c_long
+            lib.parse_ratings.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long,
+            ]
+            lib.count_lines.restype = ctypes.c_long
+            lib.count_lines.argtypes = [ctypes.c_char_p]
+            _lib = lib
+            return lib
+        except Exception as e:  # noqa: BLE001 — any failure means "no native path"
+            logger.info("native parser unavailable (%s); using pure Python", e)
+            _build_failed = True
+            return None
+
+
+def available() -> bool:
+    return _build() is not None
+
+
+def parse_ratings(path: str) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Parse ``user::movie::rating[::ts]`` rows -> (users, movies, values).
+
+    Returns None when the native library can't be built — callers fall back.
+    """
+    lib = _build()
+    if lib is None:
+        return None
+    encoded = path.encode()
+    n_lines = lib.count_lines(encoded)
+    if n_lines < 0:
+        raise FileNotFoundError(path)
+    users = np.empty(n_lines, dtype=np.int32)
+    movies = np.empty(n_lines, dtype=np.int32)
+    values = np.empty(n_lines, dtype=np.float32)
+    n = lib.parse_ratings(
+        encoded,
+        users.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        movies.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_lines,
+    )
+    if n == -3:
+        raise ValueError(f"malformed line in {path}")
+    if n < 0:
+        raise IOError(f"native parse failed ({n}) for {path}")
+    return users[:n], movies[:n], values[:n]

@@ -59,6 +59,9 @@ def decode_sweep(
     Returns {key: {recommendations, raw_response}} in input order, reusing
     entries already present in ``done`` (resume path).
     """
+    from fairness_llm_tpu.utils import with_failure_containment
+
+    generate = with_failure_containment(backend.generate)
     done = dict(done or {})
     chunk = max(config.decode_batch_size, 1)
     # Chunk over ABSOLUTE positions in the full prompt list (not the remaining
@@ -72,19 +75,24 @@ def decode_sweep(
         ]
         if not batch:
             continue
-        texts = backend.generate(
+        texts = generate(
             [p for _, p in batch],
             settings,
             seed=config.random_seed + start,
             keys=[k for k, _ in batch],
         )
         for (k, _), text in zip(batch, texts):
-            done[k] = {"recommendations": parse(text), "raw_response": text}
+            if text is None:  # contained decode failure — see utils/failures.py
+                done[k] = {"recommendations": [], "raw_response": "", "error": "decode_failed"}
+            else:
+                done[k] = {"recommendations": parse(text), "raw_response": text}
         completed = len(done)
         if save_checkpoints and config.checkpoint_every and (
             completed % config.checkpoint_every < chunk or start + chunk >= len(keys)
         ):
-            R.save_checkpoint(done, config.results_dir, phase, completed)
+            # Failed entries stay out of checkpoints so --resume retries them.
+            ok = {k: v for k, v in done.items() if "error" not in v}
+            R.save_checkpoint(ok, config.results_dir, phase, completed)
         logger.info("%s sweep: %d/%d decoded", phase, completed, len(keys))
     return {k: done[k] for k in keys if k in done}
 

@@ -62,6 +62,8 @@ def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
         return {}
     data = load_results(os.path.join(d, best)) or {}
     recs = data.get("recommendations", {})
+    # Never resume a contained failure as completed work.
+    recs = {k: v for k, v in recs.items() if not (isinstance(v, dict) and v.get("error"))}
     if recs:
         logger.info("resuming from checkpoint %s (%d profiles done)", best, len(recs))
     return recs

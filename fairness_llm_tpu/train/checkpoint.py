@@ -1,0 +1,62 @@
+"""Train-state checkpointing via orbax.
+
+The reference's only persistence is raw-recommendation JSONs with no load path
+(SURVEY.md §5.4); the sweep side of that is handled by ``pipeline/results.py``.
+This module covers the model/optimizer side: sharded ``TrainState`` save and
+restore (restore re-places each tensor onto its mesh sharding), so a training
+run survives preemption — standard practice for TPU jobs, which are
+preemptible by design.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+from fairness_llm_tpu.train.step import TrainState
+
+logger = logging.getLogger(__name__)
+
+
+def _manager(directory: str):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def save_train_state(directory: str, state: TrainState, step: Optional[int] = None) -> None:
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    step = int(state.step) if step is None else step
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    logger.info("saved train state at step %d to %s", step, directory)
+
+
+def restore_train_state(
+    directory: str, template: TrainState, step: Optional[int] = None
+) -> Optional[TrainState]:
+    """Restore the latest (or given) step; ``template`` supplies the tree
+    structure and per-leaf shardings (pass a freshly built state)."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory)
+    step = mgr.latest_step() if step is None else step
+    if step is None:
+        return None
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+        if hasattr(x, "shape")
+        else x,
+        template,
+    )
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    logger.info("restored train state step %d from %s", step, directory)
+    return restored

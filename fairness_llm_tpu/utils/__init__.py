@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: profiling hooks, failure containment."""
+
+from fairness_llm_tpu.utils.profiling import maybe_trace, phase_timer
+from fairness_llm_tpu.utils.failures import with_failure_containment
+
+__all__ = ["maybe_trace", "phase_timer", "with_failure_containment"]

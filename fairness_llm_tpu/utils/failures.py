@@ -1,0 +1,50 @@
+"""Failure containment for decode sweeps.
+
+The reference wraps every API call in try/except returning empty-result
+sentinels so one failure doesn't kill a 45-call sweep
+(``phase1_bias_detection.py:202-211``, SURVEY.md §5.3) — but it has no
+retries. Local decode fails differently (compile OOM, tunnel hiccups, bad
+checkpoint), and a whole CHUNK fails at once; this wrapper retries a failed
+chunk once (fresh attempt covers transient device errors) and then degrades
+to per-prompt empty sentinels, keeping the sweep alive and the failure
+visible in the results.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+def with_failure_containment(
+    generate: Callable[..., List[str]],
+    retries: int = 1,
+) -> Callable[..., List[Optional[str]]]:
+    """Wrap a backend ``generate`` so chunk failures return ``None`` sentinels
+    instead of raising (after ``retries`` fresh attempts).
+
+    ``None`` — not "" — so callers can tell a failed decode apart from a model
+    that legitimately emitted empty text, keep failures OUT of resume
+    checkpoints (a failed prompt must be retried on --resume, not skipped),
+    and still surface the gap in results like the reference's empty-result
+    sentinels (``phase1_bias_detection.py:202-211``)."""
+
+    def wrapped(
+        prompts: Sequence[str], settings=None, seed: int = 0, keys=None
+    ) -> List[Optional[str]]:
+        last: Optional[Exception] = None
+        for attempt in range(retries + 1):
+            try:
+                return list(generate(prompts, settings, seed=seed, keys=keys))
+            except Exception as e:  # noqa: BLE001 — containment is the point
+                last = e
+                logger.warning(
+                    "decode chunk failed (attempt %d/%d): %s",
+                    attempt + 1, retries + 1, e,
+                )
+        logger.error("decode chunk failed permanently; emitting None sentinels: %s", last)
+        return [None for _ in prompts]
+
+    return wrapped

@@ -1,0 +1,57 @@
+"""Native C parser: correctness vs the pure-Python path, and fallback safety."""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu import native
+from fairness_llm_tpu.data.movielens import _parse_ratings, load_movielens
+
+
+@pytest.fixture()
+def ratings_file(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "ratings.dat"
+    rows = []
+    for _ in range(5000):
+        u = int(rng.integers(1, 6041))
+        m = int(rng.integers(1, 3953))
+        r = int(rng.integers(1, 6))
+        ts = int(rng.integers(9e8, 1e9))
+        rows.append(f"{u}::{m}::{r}::{ts}")
+    path.write_text("\n".join(rows) + "\n")
+    return str(path)
+
+
+def test_native_builds_and_parses(ratings_file):
+    if not native.available():
+        pytest.skip("no C compiler in environment")
+    users, movies, values = native.parse_ratings(ratings_file)
+    # oracle: pure python
+    import numpy as np
+
+    lines = open(ratings_file).read().splitlines()
+    exp_u = np.array([int(l.split("::")[0]) for l in lines], np.int32)
+    exp_m = np.array([int(l.split("::")[1]) for l in lines], np.int32)
+    exp_v = np.array([float(l.split("::")[2]) for l in lines], np.float32)
+    np.testing.assert_array_equal(users, exp_u)
+    np.testing.assert_array_equal(movies, exp_m)
+    np.testing.assert_allclose(values, exp_v)
+
+
+def test_parse_ratings_wrapper_matches(ratings_file):
+    users, movies, values = _parse_ratings(ratings_file)
+    assert len(users) == len(movies) == len(values) == 5000
+    assert users.dtype == np.int32 and values.dtype == np.float32
+
+
+def test_load_movielens_end_to_end(tmp_path, ratings_file):
+    # ratings_file already lives at tmp_path/ratings.dat; add movies.dat beside it
+    (tmp_path / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Jumanji (1995)::Adventure|Children's|Fantasy\n",
+        encoding="latin-1",
+    )
+    data = load_movielens(str(tmp_path), allow_synthetic=False)
+    assert data.num_movies == 2
+    assert data.num_ratings == 5000
+    assert data.titles[0] == "Toy Story (1995)"
