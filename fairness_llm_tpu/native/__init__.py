@@ -39,10 +39,15 @@ def _build() -> Optional[ctypes.CDLL]:
         try:
             if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
                 cc = os.environ.get("CC", "cc")
+                # Build to a per-pid temp path, then atomically rename: multiple
+                # processes (multi-host ranks, pytest -n) may race the first
+                # build, and a concurrently-truncated .so would poison CDLL.
+                tmp = f"{_SO}.{os.getpid()}.tmp"
                 subprocess.run(
-                    [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
                     check=True, capture_output=True, timeout=120,
                 )
+                os.replace(tmp, _SO)
                 logger.info("built native parser %s", _SO)
             lib = ctypes.CDLL(_SO)
             lib.parse_ratings.restype = ctypes.c_long

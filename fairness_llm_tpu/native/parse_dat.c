@@ -43,8 +43,12 @@ static const char *parse_double(const char *p, const char *end, double *out) {
     return p;
 }
 
-static const char *skip_sep(const char *p, const char *end) {
-    while (p < end && *p == ':') p++;
+/* Exactly "::" — anything else (single colon, colon runs) is malformed, to
+ * match the pure-Python split("::") semantics. NULL signals the error. */
+static const char *expect_sep(const char *p, const char *end) {
+    if (p + 1 >= end || p[0] != ':' || p[1] != ':') return NULL;
+    p += 2;
+    if (p < end && *p == ':') return NULL; /* ":::" would desync fields */
     return p;
 }
 
@@ -76,14 +80,17 @@ long parse_ratings(const char *path, int32_t *users, int32_t *movies,
          * caller raises — matching the pure-Python path's ValueError instead
          * of silently emitting phantom (0, 0, 0.0) rows. */
         q = parse_long(p, end, &user);
-        if (q == p || q >= end || *q != ':') { free(buf); return -3; }
-        p = skip_sep(q, end);
+        if (q == p) { free(buf); return -3; }
+        p = expect_sep(q, end);
+        if (!p) { free(buf); return -3; }
         q = parse_long(p, end, &movie);
-        if (q == p || q >= end || *q != ':') { free(buf); return -3; }
-        p = skip_sep(q, end);
+        if (q == p) { free(buf); return -3; }
+        p = expect_sep(q, end);
+        if (!p) { free(buf); return -3; }
         q = parse_double(p, end, &val);
         if (q == p) { free(buf); return -3; }
         if (q < end && *q != ':' && *q != '\n' && *q != '\r') { free(buf); return -3; }
+        if (q < end && *q == ':' && expect_sep(q, end) == NULL) { free(buf); return -3; }
         p = q;
         users[n] = (int32_t)user;
         movies[n] = (int32_t)movie;

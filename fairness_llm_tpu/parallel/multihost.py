@@ -1,0 +1,97 @@
+"""Multi-host (multi-slice / DCN) support.
+
+The reference's only "distributed backend" is HTTPS to OpenAI (SURVEY.md
+§5.8). The TPU-native equivalent at multi-host scale is ``jax.distributed`` +
+a mesh laid out so the right collectives ride the right links:
+
+- **ICI** (intra-slice, ~100s of GB/s): tensor-parallel collectives
+  (all-gather / reduce-scatter inside the sharded matmuls) and sp ring hops —
+  the latency-sensitive traffic.
+- **DCN** (inter-slice ethernet, ~10s of GB/s): only data-parallel gradient
+  all-reduce, once per step — bandwidth-tolerant.
+
+``make_multihost_mesh`` therefore puts ``dp`` on the OUTERMOST axis ordered
+over processes (slices) so tp/sp groups never cross a DCN boundary. Single
+-process runs degrade to the local mesh; nothing here requires multi-host to
+import or test (the driver validates the sharding compiles via
+``xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from fairness_llm_tpu.config import MeshConfig
+from fairness_llm_tpu.parallel.sharding import make_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize ``jax.distributed`` from args or the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID; TPU pod
+    runtimes usually auto-detect all three). Returns True if a multi-process
+    runtime was initialized."""
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None:
+        if num_processes and num_processes > 1:
+            raise ValueError(
+                "JAX_NUM_PROCESSES > 1 but no coordinator address — set "
+                "JAX_COORDINATOR_ADDRESS (host:port of process 0)"
+            )
+        return False
+    # jax itself reads only JAX_COORDINATOR_ADDRESS from the env (verified for
+    # jax 0.9); num_processes/process_id must be forwarded explicitly.
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def make_multihost_mesh(mesh_config: MeshConfig) -> Mesh:
+    """Mesh over ALL processes' devices, dp outermost across hosts.
+
+    ``jax.devices()`` orders devices by process; reshaping (dp, tp, sp) from
+    that order puts consecutive-process devices in the same dp row, i.e. each
+    (tp, sp) group lives inside one process/slice (ICI), and only dp
+    reductions cross DCN. Requires dp to be a multiple of the process count
+    when tp*sp equals the per-process device count.
+    """
+    devices = jax.devices()
+    if mesh_config.num_devices != len(devices):
+        if mesh_config.num_devices < len(devices):
+            devices = devices[: mesh_config.num_devices]
+        else:
+            raise ValueError(
+                f"mesh {mesh_config.shape} wants {mesh_config.num_devices} devices, "
+                f"have {len(devices)} across {jax.process_count()} processes"
+            )
+    per_process = jax.local_device_count()
+    model_parallel = mesh_config.tp * mesh_config.sp
+    if jax.process_count() > 1 and model_parallel > per_process:
+        logger.warning(
+            "tp*sp=%d exceeds the %d local devices — model-parallel collectives "
+            "will cross DCN; expect a bandwidth cliff", model_parallel, per_process,
+        )
+    return make_mesh(mesh_config, devices=list(devices))

@@ -114,7 +114,8 @@ class DecodeEngine:
             positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
             cache = init_cache(cfg, batch, prompt_len + max_new)
             logits, cache = model.apply(
-                {"params": params}, tokens, positions, valid, cache, left_padded=True
+                {"params": params}, tokens, positions, valid, cache,
+                left_padded=True, last_only=True,
             )
             last_logits = logits[:, -1, :]
             # One independent key stream per row, derived from that row's seed

@@ -1,0 +1,29 @@
+"""Multi-host helpers: single-process degradation + mesh layout invariants."""
+
+import jax
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import MeshConfig
+from fairness_llm_tpu.parallel.multihost import initialize_distributed, make_multihost_mesh
+
+
+def test_initialize_distributed_noop_single_process(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize_distributed() is False
+
+
+def test_multihost_mesh_single_process(eight_device_mesh):
+    mesh = make_multihost_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    # dp outermost: the first tp*sp block of the device order forms dp row 0
+    devs = np.asarray(mesh.devices)
+    assert devs.shape == (2, 2, 2)
+    flat = [d.id for d in devs.reshape(-1)]
+    assert flat == sorted(flat)  # contiguous device order => tp/sp groups stay local
+
+
+def test_multihost_mesh_too_many_devices():
+    with pytest.raises(ValueError):
+        make_multihost_mesh(MeshConfig(dp=64, tp=8, sp=1))
