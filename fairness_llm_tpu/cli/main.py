@@ -168,6 +168,10 @@ def main(argv=None) -> int:
                                 num_profiles=args.profiles, variant=args.variant,
                                 strategy=args.strategy, save=save)
                 print_phase3_summary(p3)
+                if save:
+                    from fairness_llm_tpu.reports import generate_phase3_figure
+
+                    generate_phase3_figure(p3, f"{config.results_dir}/visualizations")
 
     print("\n" + "=" * 60)
     print("RUN COMPLETE")

@@ -366,15 +366,25 @@ class Transformer(nn.Module):
             # 64x896 prefill that's ~2 TFLOP of pure waste).
             x = x[:, -1:, :]
 
+        # Logits matmul: operands stay in the model dtype (bf16 -> full MXU
+        # rate; the [D, V] projection dominates each decode step's FLOPs) with
+        # float32 accumulation — the standard precision recipe. float32
+        # configs are unaffected.
         if cfg.tie_embeddings:
-            logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32), embed.astype(jnp.float32))
+            logits = jnp.einsum(
+                "bsd,vd->bsv", x, embed.astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            )
         else:
             lm_head = self.param(
                 "lm_head",
                 nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", "vocab")),
                 (cfg.d_model, cfg.vocab_size),
             )
-            logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.float32), lm_head.astype(jnp.float32))
+            logits = jnp.einsum(
+                "bsd,dv->bsv", x, lm_head.astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            )
         logits = nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
 
         new_cache = None

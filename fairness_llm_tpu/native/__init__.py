@@ -43,11 +43,15 @@ def _build() -> Optional[ctypes.CDLL]:
                 # processes (multi-host ranks, pytest -n) may race the first
                 # build, and a concurrently-truncated .so would poison CDLL.
                 tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _SO)
+                try:
+                    subprocess.run(
+                        [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):  # failed/timed-out compile leftovers
+                        os.unlink(tmp)
                 logger.info("built native parser %s", _SO)
             lib = ctypes.CDLL(_SO)
             lib.parse_ratings.restype = ctypes.c_long

@@ -43,12 +43,13 @@ static const char *parse_double(const char *p, const char *end, double *out) {
     return p;
 }
 
-/* Exactly "::" — anything else (single colon, colon runs) is malformed, to
- * match the pure-Python split("::") semantics. NULL signals the error. */
+/* Exactly "::" between numeric fields — a single colon or a ":::" run makes
+ * the NEXT field start with ':' which python's int()/split("::") combination
+ * rejects, so both are malformed here too. NULL signals the error. */
 static const char *expect_sep(const char *p, const char *end) {
     if (p + 1 >= end || p[0] != ':' || p[1] != ':') return NULL;
     p += 2;
-    if (p < end && *p == ':') return NULL; /* ":::" would desync fields */
+    if (p < end && *p == ':') return NULL; /* ":::" -> next field starts with ':' */
     return p;
 }
 
@@ -90,7 +91,12 @@ long parse_ratings(const char *path, int32_t *users, int32_t *movies,
         q = parse_double(p, end, &val);
         if (q == p) { free(buf); return -3; }
         if (q < end && *q != ':' && *q != '\n' && *q != '\r') { free(buf); return -3; }
-        if (q < end && *q == ':' && expect_sep(q, end) == NULL) { free(buf); return -3; }
+        /* After the rating: "::" starts the (ignored) extra field, whose
+         * CONTENT may be anything including more colons — python's
+         * split("::") puts it all in field 4. A single ':' means the rating
+         * field itself continued with garbage ("5:978"), which python's
+         * float() rejects too. */
+        if (q < end && *q == ':' && (q + 1 >= end || q[1] != ':')) { free(buf); return -3; }
         p = q;
         users[n] = (int32_t)user;
         movies[n] = (int32_t)movie;

@@ -81,6 +81,14 @@ def make_multihost_mesh(mesh_config: MeshConfig) -> Mesh:
     devices = jax.devices()
     if mesh_config.num_devices != len(devices):
         if mesh_config.num_devices < len(devices):
+            if jax.process_count() > 1:
+                # Truncating the global device list would leave some processes
+                # with no addressable devices in the mesh — every process must
+                # participate in an SPMD program or it deadlocks/raises.
+                raise ValueError(
+                    f"multi-process mesh must span all {len(devices)} global "
+                    f"devices; got {mesh_config.shape} = {mesh_config.num_devices}"
+                )
             devices = devices[: mesh_config.num_devices]
         else:
             raise ValueError(

@@ -186,6 +186,11 @@ def run_phase1(
         g: [t for lst in lists for t in lst] for g, lists in by_gender.items()
     }
     snsr, snsv, sns_sims = M.snsr_snsv(neutral_flat, recs_by_gender_flat)
+    # FaiRLLM evaluates every sensitive attribute; age is the second axis.
+    recs_by_age_flat = {
+        a: [t for lst in lists for t in lst] for a, lists in by_age.items()
+    }
+    snsr_age, snsv_age, sns_sims_age = M.snsr_snsv(neutral_flat, recs_by_age_flat)
 
     elapsed = time.time() - t0
     results = {
@@ -211,6 +216,9 @@ def run_phase1(
             "individual_fairness": {"score": if_score, "num_pairs": len(if_sims)},
             "equal_opportunity": {"score": eo_score, "group_scores": eo_rates},
             "snsr_snsv": {"snsr": snsr, "snsv": snsv, "group_similarities": sns_sims},
+            "snsr_snsv_age": {
+                "snsr": snsr_age, "snsv": snsv_age, "group_similarities": sns_sims_age,
+            },
         },
     }
     if save:
@@ -232,10 +240,28 @@ def print_phase1_summary(results: Dict) -> None:
     print(f"demographic parity (age):    {m['demographic_parity_age']['score']:.4f}")
     print(f"individual fairness:         {m['individual_fairness']['score']:.4f}")
     print(f"equal opportunity:           {m['equal_opportunity']['score']:.4f}")
-    print(f"SNSR: {m['snsr_snsv']['snsr']:.4f}   SNSV: {m['snsr_snsv']['snsv']:.4f}")
+    print(f"SNSR/SNSV (gender): {m['snsr_snsv']['snsr']:.4f} / {m['snsr_snsv']['snsv']:.4f}")
+    if "snsr_snsv_age" in m:
+        print(f"SNSR/SNSV (age):    {m['snsr_snsv_age']['snsr']:.4f} / {m['snsr_snsv_age']['snsv']:.4f}")
     for name, score in (
         ("gender parity", m["demographic_parity_gender"]["score"]),
         ("age parity", m["demographic_parity_age"]["score"]),
     ):
         level = "fair" if score >= 0.8 else ("moderate" if score >= 0.7 else "biased")
         print(f"  -> {name}: {level}")
+
+
+if __name__ == "__main__":  # standalone entry (reference phase files are executable)
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Phase 1: bias detection sweep")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--profiles", type=int, default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--no-save", action="store_true")
+    a = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    res = run_phase1(
+        model_name=a.model, num_profiles=a.profiles, save=not a.no_save, resume=a.resume
+    )
+    print_phase1_summary(res)

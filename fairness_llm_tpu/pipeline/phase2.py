@@ -219,3 +219,20 @@ def print_phase2_summary(results: Dict) -> None:
         )
     mc = results["comparison"]["method_comparison"]
     print(f"methods: listwise avg {mc['listwise_avg']:.4f} vs pairwise avg {mc['pairwise_avg']:.4f}")
+
+
+if __name__ == "__main__":  # standalone entry (reference phase files are executable)
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Phase 2: cross-model ranking fairness")
+    ap.add_argument("--models", nargs="+", default=None)
+    ap.add_argument("--num-items", type=int, default=20)
+    ap.add_argument("--num-comparisons", type=int, default=30)
+    ap.add_argument("--no-save", action="store_true")
+    a = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    res = run_phase2(
+        models=a.models, num_items=a.num_items,
+        num_comparisons=a.num_comparisons, save=not a.no_save,
+    )
+    print_phase2_summary(res)

@@ -292,3 +292,21 @@ def print_phase3_summary(results: Dict) -> None:
     print(f"bias reduction: {b['bias_reduction_rate']:.2f}%  (target {s['bias_reduction_target_pct']:.0f}%: {'MET' if s['bias_reduction_met'] else 'not met'})")
     print(f"quality preservation: {q['quality_preservation_pct']:.2f}%  (min {s['quality_min_pct']:.0f}%: {'MET' if s['quality_met'] else 'not met'})")
     print(f"blended group fairness: {results['blended_fairness']:.4f}")
+
+
+if __name__ == "__main__":  # standalone entry (reference phase files are executable)
+    import argparse
+
+    ap = argparse.ArgumentParser(description="Phase 3: FACTER mitigation")
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--profiles", type=int, default=None)
+    ap.add_argument("--variant", default="conformal", choices=VARIANTS)
+    ap.add_argument("--strategy", default="demographic_parity")
+    ap.add_argument("--no-save", action="store_true")
+    a = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    res = run_phase3(
+        model_name=a.model, num_profiles=a.profiles, variant=a.variant,
+        strategy=a.strategy, save=not a.no_save,
+    )
+    print_phase3_summary(res)

@@ -1,5 +1,9 @@
 """Reports: figures + text summaries over saved phase results."""
 
-from fairness_llm_tpu.reports.figures import generate_phase1_figures, generate_summary_report
+from fairness_llm_tpu.reports.figures import (
+    generate_phase1_figures,
+    generate_phase3_figure,
+    generate_summary_report,
+)
 
-__all__ = ["generate_phase1_figures", "generate_summary_report"]
+__all__ = ["generate_phase1_figures", "generate_phase3_figure", "generate_summary_report"]

@@ -96,6 +96,40 @@ def generate_phase1_figures(results: Dict, out_dir: str) -> List[str]:
     return written
 
 
+def generate_phase3_figure(results: Dict, out_dir: str) -> str:
+    """Before/after mitigation bars (fairness, bias, quality) — a figure the
+    reference's notebook never had for phase 3."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(out_dir, exist_ok=True)
+    b = results["bias_reduction"]
+    q = results["quality_preservation"]
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4.5))
+    axes[0].bar(
+        ["before", "after"],
+        [b["original_fairness"], b["mitigated_fairness"]],
+        color=["#e76f51", "#2a9d8f"],
+    )
+    axes[0].set_ylim(0, 1.05)
+    axes[0].set_title(
+        f"Demographic parity — bias reduced {b['bias_reduction_rate']:.1f}%"
+    )
+    axes[1].bar(
+        ["quality preserved"], [q["quality_preservation_pct"]], color="#457b9d"
+    )
+    axes[1].set_ylim(0, 105)
+    axes[1].set_title(f"Quality preservation ({q['num_comparisons']} profiles)")
+    variant = results["metadata"]["variant"]
+    path = os.path.join(out_dir, f"phase3_{variant}_mitigation.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    logger.info("wrote %s", path)
+    return path
+
+
 def generate_summary_report(results: Dict, path: Optional[str] = None) -> str:
     """Text mirror of the reference's ``phase1_summary_report.txt``."""
     m = results["metrics"]
