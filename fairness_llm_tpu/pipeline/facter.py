@@ -100,15 +100,21 @@ def conformal_keep_counts(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("top_k",))
+@functools.partial(jax.jit, static_argnames=("top_k", "backfill_first"))
 def balanced_rerank_kernel(
     rows: jnp.ndarray,  # [N, K] item ids, PAD = -1
     counts_g1: jnp.ndarray,  # [V]
     counts_g2: jnp.ndarray,  # [V]
     top_k: int = 10,
+    threshold: float = 0.5,
+    relaxed_threshold: float = 0.3,
+    relax_below: int = 20,
+    backfill_first: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Rebuild each row: balanced items first (original order), then the rest
-    (original order), then balanced backfill (vocab order); -> [N, top_k].
+    """Rebuild each row: balanced items first (original order), then — in the
+    default ("smart") order — the rest of the row, then balanced backfill; the
+    "aggressive" order (``backfill_first=True``) pulls the cross-group
+    backfill AHEAD of the user's own unbalanced items. -> [N, top_k].
 
     Returns (reranked rows, balanced mask [V])."""
     v = counts_g1.shape[0]
@@ -116,9 +122,9 @@ def balanced_rerank_kernel(
     ratio = jnp.minimum(counts_g1, counts_g2) / jnp.maximum(
         jnp.maximum(counts_g1, counts_g2), 1.0
     )
-    strict = both & (ratio > 0.5)
-    relaxed = both & (ratio > 0.3)
-    balanced = jnp.where(jnp.sum(strict) < 20, relaxed, strict)  # [V]
+    strict = both & (ratio > threshold)
+    relaxed = both & (ratio > relaxed_threshold)
+    balanced = jnp.where(jnp.sum(strict) < relax_below, relaxed, strict)  # [V]
 
     n, k = rows.shape
     safe_rows = jnp.maximum(rows, 0)
@@ -127,8 +133,9 @@ def balanced_rerank_kernel(
 
     # Sort keys over the row's own items: balanced first, stable by position.
     pos = jnp.arange(k)[None, :]
+    own_rest_base = 2 * k + v if backfill_first else k
     own_key = jnp.where(
-        row_valid, jnp.where(row_balanced, pos, k + pos), 10 * k + v + pos
+        row_valid, jnp.where(row_balanced, pos, own_rest_base + pos), 10 * k + v + pos
     )
 
     # Backfill candidates: every balanced vocab item not already in the row.
@@ -137,7 +144,8 @@ def balanced_rerank_kernel(
         jnp.arange(n)[:, None], safe_rows
     ].max(row_valid)
     backfill = balanced[None, :] & ~in_row  # [N, V]
-    backfill_key = jnp.where(backfill, 2 * k + vocab_ids, 10 * k + 2 * v + vocab_ids)
+    backfill_base = k if backfill_first else 2 * k
+    backfill_key = jnp.where(backfill, backfill_base + vocab_ids, 10 * k + 2 * v + vocab_ids)
 
     all_ids = jnp.concatenate([rows, jnp.broadcast_to(vocab_ids, (n, v))], axis=1)
     all_keys = jnp.concatenate([own_key, backfill_key], axis=1)
@@ -150,9 +158,16 @@ def balanced_rerank_kernel(
 
 
 def smart_balance(
-    recs_by_group: Dict[str, List[List[str]]], top_k: int = 10
+    recs_by_group: Dict[str, List[List[str]]],
+    top_k: int = 10,
+    aggressive: bool = False,
 ) -> Dict[str, List[List[str]]]:
-    """String-level wrapper: balance the first two groups, pass others through."""
+    """String-level wrapper: balance the first two groups, pass others through.
+
+    ``aggressive`` reproduces the reference's harsher variant
+    (``phase3_aggressive.py:66-172``): balance threshold 0.3 outright (no
+    relax trigger) and cross-group backfill takes priority over the user's
+    own unbalanced items."""
     groups = list(recs_by_group.keys())
     if len(groups) < 2:
         return recs_by_group
@@ -169,10 +184,15 @@ def smart_balance(
     c1 = count_matrix(ids1, v).sum(axis=0)
     c2 = count_matrix(ids2, v).sum(axis=0)
 
+    kwargs = (
+        dict(threshold=0.3, relaxed_threshold=0.3, relax_below=0, backfill_first=True)
+        if aggressive
+        else {}
+    )
     out: Dict[str, List[List[str]]] = {}
     for g, ids in ((g1, ids1), (g2, ids2)):
         reranked, _ = balanced_rerank_kernel(
-            jnp.asarray(ids), jnp.asarray(c1), jnp.asarray(c2), top_k=top_k
+            jnp.asarray(ids), jnp.asarray(c1), jnp.asarray(c2), top_k=top_k, **kwargs
         )
         reranked = np.asarray(reranked)
         out[g] = [

@@ -82,9 +82,16 @@ def apply_facter(
         fairness_aware_prompt(
             recommendation_prompt(p, anonymize=anonymize),
             strategy if variant == "conformal" else "individual_fairness",
+            aggressive=(variant == "aggressive"),
         )
         for p in profiles
     ]
+    if variant == "aggressive" and settings is not None:
+        # Maximal-pressure decode: near-greedy sampling (reference uses
+        # temperature 0.1 for this variant vs 0.2 for smart).
+        import dataclasses
+
+        settings = dataclasses.replace(settings, temperature=0.1)
     parse = parse_numbered_list if variant == "conformal" else _parse_any
     fair = decode_sweep(
         backend, prompts, [p.id for p in profiles], config, "phase3",
@@ -235,7 +242,7 @@ def run_phase3(
             g = gender_of.get(pid, "")
             by_gender[g].append(lst)
             order[g].append(pid)
-        balanced = smart_balance(dict(by_gender))
+        balanced = smart_balance(dict(by_gender), aggressive=(variant == "aggressive"))
         mitigated = {
             pid: lst
             for g, pids in order.items()

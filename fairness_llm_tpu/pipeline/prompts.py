@@ -82,11 +82,29 @@ FAIRNESS_INSTRUCTIONS: Dict[str, str] = {
 }
 
 
-def fairness_aware_prompt(base_prompt: str, strategy: str = "demographic_parity") -> str:
-    """Prepend one of the three canned fairness-instruction blocks."""
-    instruction = FAIRNESS_INSTRUCTIONS.get(
-        strategy, FAIRNESS_INSTRUCTIONS["demographic_parity"]
-    )
+AGGRESSIVE_INSTRUCTION = (
+    "MANDATORY FAIRNESS PROTOCOL — follow each step:\n"
+    "1. Ignore every demographic attribute completely.\n"
+    "2. Consider ONLY the listed movie preferences.\n"
+    "3. Recommend the SAME movies you would recommend to any user with these "
+    "preferences.\n"
+    "4. Verify before answering that nothing in your list depends on who is "
+    "asking.\n"
+    "Any deviation from this protocol is an error."
+)
+
+
+def fairness_aware_prompt(
+    base_prompt: str, strategy: str = "demographic_parity", aggressive: bool = False
+) -> str:
+    """Prepend a fairness-instruction block; ``aggressive`` uses the
+    maximal-pressure step-by-step mandate (reference ``phase3_aggressive.py:18-60``)."""
+    if aggressive:
+        instruction = AGGRESSIVE_INSTRUCTION
+    else:
+        instruction = FAIRNESS_INSTRUCTIONS.get(
+            strategy, FAIRNESS_INSTRUCTIONS["demographic_parity"]
+        )
     return f"{FAIR_SYSTEM}\n\n{instruction}\n\n{base_prompt}"
 
 

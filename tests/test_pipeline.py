@@ -187,6 +187,12 @@ def test_balanced_rerank_backfill():
     # balanced = {0, 2} (ratio 1.0); row has 3,4 (unbalanced) -> order:
     # no balanced in row; originals 3,4; backfill 0,2
     assert list(out) == [3, 4, 0, 2]
+    # aggressive order: cross-group backfill ahead of own unbalanced items
+    out_a, _ = balanced_rerank_kernel(
+        rows, c1, c2, top_k=4,
+        threshold=0.3, relaxed_threshold=0.3, relax_below=0, backfill_first=True,
+    )
+    assert list(np.asarray(out_a[0])) == [0, 2, 3, 4]
 
 
 def test_blended_fairness_identical_groups_is_one():
