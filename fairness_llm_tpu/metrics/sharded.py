@@ -1,0 +1,55 @@
+"""On-device metric reduction over a dp-sharded sweep (SURVEY.md §7.2 /
+BASELINE.json north-star: "on-device fairness-metric reduction").
+
+When the profile sweep is data-parallel over the ``dp`` axis, each device
+holds its shard's per-profile item counts. The reduction to fairness scores
+then happens ON DEVICE: a ``psum`` over ``dp`` produces identical per-group
+count matrices everywhere, and the (tiny) divergence math runs replicated —
+no host gather of per-profile data, only the final scalars leave the device.
+
+This is the TPU analog of the reference's host-side numpy aggregation
+(``utils.py:172-215``), and composes with the single-device kernels in
+``metrics/fairness.py`` (same math, golden-tested).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fairness_llm_tpu.metrics.fairness import demographic_parity_kernel
+
+
+def sharded_demographic_parity(
+    mesh: Mesh,
+    per_profile_counts: jnp.ndarray,  # [N, V] float32 — N profiles, V vocab
+    group_ids: jnp.ndarray,  # [N] int32
+    num_groups: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Demographic parity with the group-count accumulation dp-sharded.
+
+    Profiles shard over ``dp``; each device segment-sums its local profiles
+    into [G, V] and ``psum`` completes the reduction over ICI. Returns
+    (score, [G, G] JS matrix), replicated.
+    """
+    from jax import shard_map
+
+    def local_reduce(counts, gids):
+        local = jax.ops.segment_sum(counts, gids, num_segments=num_groups)  # [G, V]
+        total = jax.lax.psum(local, "dp")
+        score, js = demographic_parity_kernel(total)
+        return score, js
+
+    fn = shard_map(
+        local_reduce,
+        mesh=mesh,
+        in_specs=(P("dp", None), P("dp")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    counts_sharded = jax.device_put(per_profile_counts, NamedSharding(mesh, P("dp", None)))
+    gids_sharded = jax.device_put(group_ids, NamedSharding(mesh, P("dp")))
+    return fn(counts_sharded, gids_sharded)

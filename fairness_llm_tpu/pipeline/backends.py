@@ -115,7 +115,7 @@ class SimulatedRecommender:
     def _recommend(self, prompt: str, idx: int, seed: int, n: int = 10) -> str:
         gender = (_GENDER_RE.search(prompt) or [None, "neutral"])[1].lower()
         age = (_AGE_RE.search(prompt) or [None, "neutral"])[1].lower()
-        fair = "FAIRNESS REQUIREMENT" in prompt
+        fair = "FAIRNESS REQUIREMENT" in prompt or "FAIRNESS PROTOCOL" in prompt
         bias = self.bias * (1.0 - self.mitigation) if fair else self.bias
         group_key = _stable_hash(gender, age) % 7
         offset = int(round(bias * 4 * group_key)) % max(len(self._shuffled) - 2 * n, 1)

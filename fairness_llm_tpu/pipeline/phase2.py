@@ -118,7 +118,18 @@ def evaluate_model(
     lw_er, lw_exposure = _exposure(lw_ranked, items)
     pw_ranked, comparisons = pairwise_evaluation(backend, items, num_comparisons, settings, seed)
     pw_er, pw_exposure = _exposure(pw_ranked, items)
+    extras: Dict = {}
+    engine = getattr(backend, "engine", None)
+    if engine is not None:
+        # Real in-framework model: add corpus perplexity over the item texts —
+        # a model-quality signal the reference's API-only setup couldn't get.
+        from fairness_llm_tpu.runtime.scoring import perplexity_by_model
+
+        extras["corpus_perplexity"] = perplexity_by_model(
+            {backend.name: engine}, [it.text for it in items]
+        )[backend.name]
     return {
+        **extras,
         "listwise": {
             "ranking": lw_ranked,
             "exposure_ratio": lw_er,

@@ -55,13 +55,16 @@ def _bucket_len(n: int, multiple: int = 64) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
-def _bucket_batch(n: int) -> int:
+def _bucket_batch(n: int, mesh: Optional[jax.sharding.Mesh] = None) -> int:
     # Multiples of 8 (sublane granularity), not powers of two: decode steps
     # stream the whole [B, max_len] KV cache from HBM, so padding 45 -> 64
     # rows would inflate that traffic 42% for nothing; 45 -> 48 costs 7%.
-    if n <= 8:
-        return 8
-    return ((n + 7) // 8) * 8
+    # With a mesh, the batch must also divide the dp axis.
+    b = 8 if n <= 8 else ((n + 7) // 8) * 8
+    if mesh is not None:
+        dp = mesh.shape.get("dp", 1)
+        b = ((b + dp - 1) // dp) * dp
+    return b
 
 
 class DecodeEngine:
@@ -212,10 +215,7 @@ class DecodeEngine:
             prompt_len = prompt_budget
         if tb.tokens.shape[1] > prompt_len:
             tb = self.tokenizer.encode_batch(prompts, max_len=prompt_len)
-        batch = _bucket_batch(n)
-        if self.mesh is not None:
-            dp = self.mesh.shape.get("dp", 1)
-            batch = ((batch + dp - 1) // dp) * dp  # dp-sharded batch must divide
+        batch = _bucket_batch(n, self.mesh)
         tokens = np.full((batch, prompt_len), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((batch, prompt_len), dtype=bool)
         s = tb.tokens.shape[1]

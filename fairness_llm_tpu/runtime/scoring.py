@@ -1,0 +1,114 @@
+"""Batched sequence scoring: per-token log-likelihoods under a model.
+
+The decode engine answers "what would the model say"; scoring answers "how
+likely is this text" — needed for perplexity-style model comparison (a
+natural extension of the reference's phase-2 cross-MODEL evaluation, which
+only compares rankings) and for calibration confidences that are real instead
+of the reference's simulated ``1 - 0.05*rank`` (``phase3_facter_mitigation.py:126``).
+
+One jitted forward per bucketed shape; mesh-sharded exactly like the decode
+path. For sequences longer than one chip's memory, the sp axis applies (the
+model's attention runs ring-style via GSPMD when activations are
+seq-sharded).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairness_llm_tpu.runtime.engine import DecodeEngine, _bucket_batch, _bucket_len
+
+
+@dataclasses.dataclass
+class ScoreOutput:
+    log_likelihoods: np.ndarray  # [N] sum log p(token | prefix) over real tokens
+    token_counts: np.ndarray  # [N] number of scored tokens
+    mean_logprobs: np.ndarray  # [N] log_likelihood / token_count
+
+
+def score_texts(
+    engine: DecodeEngine, texts: Sequence[str], seed: int = 0
+) -> ScoreOutput:
+    """Score each text's tokens under the engine's model (teacher-forced)."""
+    tb = engine.tokenizer.encode_batch(texts)
+    max_len = engine.config.max_seq_len
+    if tb.tokens.shape[1] > max_len:
+        # Position tables/caches hold max_seq_len slots and out-of-range
+        # gathers clamp silently under jit (same hazard engine.generate
+        # guards); keep the most recent tokens, like the decode path.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "scoring texts longer than max_seq_len=%d; left-truncating", max_len
+        )
+        tb = engine.tokenizer.encode_batch(texts, max_len=max_len)
+    s = min(_bucket_len(tb.tokens.shape[1]), max_len)
+    if tb.tokens.shape[1] > s:
+        tb = engine.tokenizer.encode_batch(texts, max_len=s)
+    n = len(texts)
+    batch = _bucket_batch(n, engine.mesh)
+    tokens = np.full((batch, s), engine.tokenizer.pad_id, dtype=np.int32)
+    valid = np.zeros((batch, s), dtype=bool)
+    w = tb.tokens.shape[1]
+    tokens[:n, s - w:] = tb.tokens
+    valid[:n, s - w:] = tb.valid
+
+    key = (batch, s, "score")
+    fn = engine._compiled.get(key)
+    if fn is None:
+        model = engine.model
+
+        def run(params, tokens, valid):
+            positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+            logits, _ = model.apply(
+                {"params": params}, tokens[:, :-1], positions[:, :-1],
+                valid[:, :-1], left_padded=True,
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            targets = tokens[:, 1:]
+            tvalid = valid[:, :-1] & valid[:, 1:]
+            picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            picked = jnp.where(tvalid, picked, 0.0)
+            return jnp.sum(picked, axis=1), jnp.sum(tvalid, axis=1)
+
+        fn = jax.jit(run)
+        engine._compiled[key] = fn
+
+    tokens_j, valid_j = jnp.asarray(tokens), jnp.asarray(valid)
+    if engine.mesh is not None:
+        from fairness_llm_tpu.parallel import sharding as shd
+
+        bs = shd.batch_sharding(engine.mesh)
+        tokens_j = jax.device_put(tokens_j, bs)
+        valid_j = jax.device_put(valid_j, bs)
+        with engine.mesh, nn.logical_axis_rules(engine.rules):
+            ll, counts = fn(engine.params, tokens_j, valid_j)
+    else:
+        ll, counts = fn(engine.params, tokens_j, valid_j)
+
+    ll = np.asarray(jax.device_get(ll))[:n]
+    counts = np.asarray(jax.device_get(counts))[:n]
+    return ScoreOutput(
+        log_likelihoods=ll,
+        token_counts=counts,
+        mean_logprobs=np.where(counts > 0, ll / np.maximum(counts, 1), 0.0),
+    )
+
+
+def perplexity_by_model(
+    engines: Dict[str, DecodeEngine], texts: Sequence[str]
+) -> Dict[str, float]:
+    """Cross-model comparison: corpus perplexity per model."""
+    out = {}
+    for name, engine in engines.items():
+        sc = score_texts(engine, texts)
+        total_lp = float(sc.log_likelihoods.sum())
+        total_tok = int(sc.token_counts.sum())
+        out[name] = float(np.exp(-total_lp / max(total_tok, 1)))
+    return out
