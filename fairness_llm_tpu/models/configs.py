@@ -45,6 +45,11 @@ class ModelConfig:
     # Pallas flash-attention for prefill/training attention on TPU (falls back
     # to the XLA path off-TPU or when shapes don't meet the 128-lane tiling).
     use_flash_attention: bool = True
+    # int8 KV cache with per-vector scales: halves cache MEMORY (the enabler
+    # for long-context / big-batch decode that wouldn't otherwise fit HBM).
+    # Measured on v5e gpt2-small it is ~8% slower than bf16 — the dequant adds
+    # work — so it's a capacity lever, not a speed lever. Opt-in.
+    kv_cache_quant: bool = False
 
     @property
     def q_dim(self) -> int:

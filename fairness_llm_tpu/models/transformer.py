@@ -39,8 +39,26 @@ def _dtype_of(config: ModelConfig):
 
 @flax.struct.dataclass
 class LayerCache:
+    """k/v are either the model dtype, or int8 with per-(slot, head) float32
+    scales when ``config.kv_cache_quant`` — halving the HBM bytes each decode
+    step must stream (decode is KV-read-bound; see runtime/engine.py)."""
+
     k: jnp.ndarray  # [B, max_len, n_kv, head_dim]
     v: jnp.ndarray  # [B, max_len, n_kv, head_dim]
+    k_scale: Optional[jnp.ndarray] = None  # [B, max_len, n_kv] float32
+    v_scale: Optional[jnp.ndarray] = None
+
+
+def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, S, H, D] -> (int8 values, [B, S, H] scales), symmetric per-vector."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 @flax.struct.dataclass
@@ -66,13 +84,22 @@ class KVCache:
 
 def init_cache(config: ModelConfig, batch_size: int, max_len: int, dtype=None) -> KVCache:
     dtype = dtype or _dtype_of(config)
-    layers = tuple(
-        LayerCache(
-            k=jnp.zeros((batch_size, max_len, config.num_kv_heads, config.head_dim), dtype),
-            v=jnp.zeros((batch_size, max_len, config.num_kv_heads, config.head_dim), dtype),
+    shape = (batch_size, max_len, config.num_kv_heads, config.head_dim)
+    if config.kv_cache_quant:
+        layers = tuple(
+            LayerCache(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(shape[:3], jnp.float32),
+                v_scale=jnp.zeros(shape[:3], jnp.float32),
+            )
+            for _ in range(config.num_layers)
         )
-        for _ in range(config.num_layers)
-    )
+    else:
+        layers = tuple(
+            LayerCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+            for _ in range(config.num_layers)
+        )
     return KVCache(
         layers=layers,
         key_valid=jnp.zeros((batch_size, max_len), jnp.bool_),
@@ -182,9 +209,20 @@ class Attention(nn.Module):
         # Shared cache write (prefill records the prompt for later decode steps).
         if cache_layer is not None:
             zero = jnp.zeros((), jnp.int32)
-            keys = jax.lax.dynamic_update_slice(cache_layer.k, k.astype(dtype), (zero, cache_index, zero, zero))
-            values = jax.lax.dynamic_update_slice(cache_layer.v, v.astype(dtype), (zero, cache_index, zero, zero))
-            new_cache_layer = LayerCache(k=keys, v=values)
+            if cfg.kv_cache_quant:
+                qk, k_sc = _quantize_kv(k)
+                qv, v_sc = _quantize_kv(v)
+                ck = jax.lax.dynamic_update_slice(cache_layer.k, qk, (zero, cache_index, zero, zero))
+                cv = jax.lax.dynamic_update_slice(cache_layer.v, qv, (zero, cache_index, zero, zero))
+                cks = jax.lax.dynamic_update_slice(cache_layer.k_scale, k_sc, (zero, cache_index, zero))
+                cvs = jax.lax.dynamic_update_slice(cache_layer.v_scale, v_sc, (zero, cache_index, zero))
+                new_cache_layer = LayerCache(k=ck, v=cv, k_scale=cks, v_scale=cvs)
+                keys = _dequantize_kv(ck, cks, dtype)
+                values = _dequantize_kv(cv, cvs, dtype)
+            else:
+                keys = jax.lax.dynamic_update_slice(cache_layer.k, k.astype(dtype), (zero, cache_index, zero, zero))
+                values = jax.lax.dynamic_update_slice(cache_layer.v, v.astype(dtype), (zero, cache_index, zero, zero))
+                new_cache_layer = LayerCache(k=keys, v=values)
         else:
             keys, values = k, v
             new_cache_layer = None

@@ -25,7 +25,7 @@ from __future__ import annotations
 import logging
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from fairness_llm_tpu import metrics as M
 from fairness_llm_tpu.config import Config, default_config
@@ -105,6 +105,34 @@ def group_by(profiles: Sequence[Profile], recs: Dict[str, Dict], attr: str) -> D
     return dict(out)
 
 
+def measure_demographic_parity(
+    recommendations_by_group: Dict[str, List[List[str]]]
+) -> Tuple[float, Dict]:
+    """Reference-parity wrapper (``phase1_bias_detection.py:214-218``)."""
+    return M.demographic_parity(recommendations_by_group)
+
+
+def measure_individual_fairness(
+    profiles: Sequence[Profile], recommendations: Dict[str, List[str]]
+) -> Tuple[float, List[float]]:
+    """Reference-parity wrapper (``phase1_bias_detection.py:220-239``):
+    mean Jaccard over counterfactual pairs differing in one attribute."""
+    return M.individual_fairness(profile_pairs(profiles), recommendations)
+
+
+def measure_equal_opportunity(
+    recommendations_by_group: Dict[str, List[List[str]]],
+    qualified: Set[str],
+) -> Tuple[float, Dict[str, float]]:
+    """Reference-parity wrapper (``phase1_bias_detection.py:241-263``) with
+    canonicalized title matching (fixes the vacuous-1.0 bug, SURVEY.md §8.2)."""
+    canon_groups = {
+        g: [canonicalize(r) for r in lists]
+        for g, lists in recommendations_by_group.items()
+    }
+    return M.equal_opportunity(canon_groups, set(canonicalize(sorted(qualified))))
+
+
 def qualified_movies(data, top_n: int = 10, seed: int = 42) -> List[str]:
     """'Qualified' set for equal opportunity: the corpus's top-rated popular
     movies (the reference hard-codes 10 classics that never textually match
@@ -168,18 +196,14 @@ def run_phase1(
     by_gender = group_by(profiles, recs, "gender")
     by_age = group_by(profiles, recs, "age")
 
-    dp_gender, dp_gender_detail = M.demographic_parity(by_gender)
-    dp_age, dp_age_detail = M.demographic_parity(by_age)
+    dp_gender, dp_gender_detail = measure_demographic_parity(by_gender)
+    dp_age, dp_age_detail = measure_demographic_parity(by_age)
 
-    pairs = profile_pairs(profiles)
     flat_recs = {pid: r["recommendations"] for pid, r in recs.items()}
-    if_score, if_sims = M.individual_fairness(pairs, flat_recs)
+    if_score, if_sims = measure_individual_fairness(profiles, flat_recs)
 
-    qualified = set(canonicalize(qualified_movies(data, seed=config.random_seed)))
-    by_gender_canon = {
-        g: [canonicalize(r) for r in lists] for g, lists in by_gender.items()
-    }
-    eo_score, eo_rates = M.equal_opportunity(by_gender_canon, qualified)
+    qualified = set(qualified_movies(data, seed=config.random_seed))
+    eo_score, eo_rates = measure_equal_opportunity(by_gender, qualified)
 
     neutral_flat = [t for r in neutral_recs for t in r["recommendations"]]
     recs_by_gender_flat = {

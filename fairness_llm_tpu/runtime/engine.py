@@ -102,6 +102,18 @@ class DecodeEngine:
         self.params = params
         self._compiled: Dict[Tuple, Any] = {}
 
+    @property
+    def seq_bucket(self) -> int:
+        """Sequence bucket multiple: 128 only when this model can actually take
+        the Pallas flash path (head_dim tiling + TPU); otherwise 64 to halve
+        padding. Shared by decode prefill and scoring so both stay eligible."""
+        flash_eligible = (
+            self.config.use_flash_attention
+            and self.config.head_dim % 128 == 0
+            and jax.default_backend() == "tpu"
+        )
+        return 128 if flash_eligible else 64
+
     # -- compiled program ---------------------------------------------------
 
     def _decode_fn(self, batch: int, prompt_len: int, max_new: int, sampler_settings: SamplerSettings):
@@ -202,15 +214,7 @@ class DecodeEngine:
         prompt_budget = self.config.max_seq_len - max_new
         n = len(prompts)
         tb = self.tokenizer.encode_batch(prompts)
-        # Bucket to 128 only when this model can actually take the Pallas flash
-        # path (head_dim tiling + TPU); otherwise 64 to halve prefill padding.
-        flash_eligible = (
-            self.config.use_flash_attention
-            and self.config.head_dim % 128 == 0
-            and jax.default_backend() == "tpu"
-        )
-        bucket = 128 if flash_eligible else 64
-        prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget), bucket)
+        prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget), self.seq_bucket)
         if prompt_len > prompt_budget:
             prompt_len = prompt_budget
         if tb.tokens.shape[1] > prompt_len:

@@ -48,9 +48,8 @@ def score_texts(
             "scoring texts longer than max_seq_len=%d; left-truncating", max_len
         )
         tb = engine.tokenizer.encode_batch(texts, max_len=max_len)
-    s = min(_bucket_len(tb.tokens.shape[1]), max_len)
-    if tb.tokens.shape[1] > s:
-        tb = engine.tokenizer.encode_batch(texts, max_len=s)
+    # Bucket with the engine's multiple so the forward stays flash-eligible.
+    s = min(_bucket_len(tb.tokens.shape[1], engine.seq_bucket), max_len)
     n = len(texts)
     batch = _bucket_batch(n, engine.mesh)
     tokens = np.full((batch, s), engine.tokenizer.pad_id, dtype=np.int32)
@@ -66,11 +65,12 @@ def score_texts(
 
         def run(params, tokens, valid):
             positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+            # Forward over the FULL bucketed length (keeps seq a flash-eligible
+            # multiple); the last position's logits predict nothing and drop.
             logits, _ = model.apply(
-                {"params": params}, tokens[:, :-1], positions[:, :-1],
-                valid[:, :-1], left_padded=True,
+                {"params": params}, tokens, positions, valid, left_padded=True
             )
-            logp = jax.nn.log_softmax(logits, axis=-1)
+            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
             targets = tokens[:, 1:]
             tvalid = valid[:, :-1] & valid[:, 1:]
             picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

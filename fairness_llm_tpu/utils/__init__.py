@@ -2,5 +2,6 @@
 
 from fairness_llm_tpu.utils.profiling import maybe_trace, phase_timer
 from fairness_llm_tpu.utils.failures import with_failure_containment
+from fairness_llm_tpu.utils.ratelimit import RateLimiter
 
-__all__ = ["maybe_trace", "phase_timer", "with_failure_containment"]
+__all__ = ["maybe_trace", "phase_timer", "with_failure_containment", "RateLimiter"]

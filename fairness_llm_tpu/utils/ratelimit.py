@@ -1,0 +1,39 @@
+"""Sliding-window rate limiter (reference ``utils.RateLimiter``,
+``utils.py:386-408``).
+
+On-device decode has no quota, so the pipeline never uses this — it exists
+for users who point a ``DecodeBackend`` at an external rate-limited service
+(the reference's whole inference layer was such a service). Semantics match
+the reference: at most ``calls_per_minute`` calls in any trailing 60 s
+window, sleeping until the oldest call ages out.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque
+
+
+class RateLimiter:
+    def __init__(self, calls_per_minute: int = 60, window_seconds: float = 60.0):
+        self.calls_per_minute = calls_per_minute
+        self.window = window_seconds
+        self._times: Deque[float] = deque()
+
+    def wait_if_needed(self) -> float:
+        """Block until a call is allowed; returns seconds slept."""
+        now = time.monotonic()
+        while self._times and now - self._times[0] >= self.window:
+            self._times.popleft()
+        slept = 0.0
+        if len(self._times) >= self.calls_per_minute:
+            wait = self.window - (now - self._times[0])
+            if wait > 0:
+                time.sleep(wait)
+                slept = wait
+            now = time.monotonic()
+            while self._times and now - self._times[0] >= self.window:
+                self._times.popleft()
+        self._times.append(time.monotonic())
+        return slept

@@ -1,0 +1,83 @@
+"""backend_for with a real on-disk checkpoint: the full weights_dir path
+(save HF layout -> resolve -> load -> decode) plus the no-weights refusal,
+and the reference-parity measure_* wrappers + RateLimiter."""
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import Config, ModelSettings
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.models.transformer import init_params
+from fairness_llm_tpu.pipeline.backends import EngineBackend, backend_for
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.runtime.weights import save_checkpoint_hf
+from fairness_llm_tpu.utils import RateLimiter
+
+
+def test_backend_for_loads_weights_dir(tmp_path):
+    cfg_model = get_model_config("tiny-test")
+    params = init_params(cfg_model, jax.random.key(0))
+    save_checkpoint_hf(cfg_model, params, str(tmp_path / "tiny-test"))
+
+    config = Config(weights_dir=str(tmp_path))
+    backend = backend_for("tiny-test", config)
+    assert isinstance(backend, EngineBackend)
+    texts = backend.generate(["hello"], ModelSettings(temperature=0.0, max_tokens=4))
+    assert len(texts) == 1
+
+    # loaded weights must reproduce the original params' greedy output
+    direct = DecodeEngine(cfg_model, params=params)
+    expect = direct.generate(["hello"], ModelSettings(temperature=0.0, max_tokens=4))
+    got = backend.engine.generate(["hello"], ModelSettings(temperature=0.0, max_tokens=4))
+    np.testing.assert_array_equal(expect.tokens, got.tokens)
+
+
+def test_backend_for_refuses_without_weights(tmp_path):
+    config = Config(weights_dir=str(tmp_path))  # empty dir
+    with pytest.raises(FileNotFoundError):
+        backend_for("tiny-test", config)
+    # explicit opt-in for smoke runs
+    backend = backend_for("tiny-test", config, allow_random=True)
+    assert isinstance(backend, EngineBackend)
+
+
+def test_measure_wrappers():
+    from fairness_llm_tpu.data.profiles import Profile
+    from fairness_llm_tpu.pipeline.phase1 import (
+        measure_demographic_parity,
+        measure_equal_opportunity,
+        measure_individual_fairness,
+    )
+
+    groups = {"m": [["A", "B"]], "f": [["A", "C"]]}
+    dp, _ = measure_demographic_parity(groups)
+    assert 0 < dp < 1
+
+    profiles = [
+        Profile("p0", "m", "18-24", "x", [], []),
+        Profile("p1", "f", "18-24", "x", [], []),
+    ]
+    if_score, sims = measure_individual_fairness(
+        profiles, {"p0": ["A", "B"], "p1": ["A", "C"]}
+    )
+    assert if_score == pytest.approx(1 / 3)
+
+    # canonicalization: year-suffixed outputs still match qualified titles
+    eo, rates = measure_equal_opportunity(
+        {"m": [["The Matrix (1999)"]], "f": [["Alien (1979)"]]},
+        {"Matrix, The", "Alien"},
+    )
+    assert rates["m"] == 1.0 and rates["f"] == 1.0
+
+
+def test_rate_limiter_blocks_third_call():
+    rl = RateLimiter(calls_per_minute=2, window_seconds=0.2)
+    assert rl.wait_if_needed() == 0.0
+    assert rl.wait_if_needed() == 0.0
+    t0 = time.monotonic()
+    slept = rl.wait_if_needed()
+    assert slept > 0.0 and time.monotonic() - t0 >= 0.1
