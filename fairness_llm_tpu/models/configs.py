@@ -50,6 +50,11 @@ class ModelConfig:
     # Measured on v5e gpt2-small it is ~8% slower than bf16 — the dequant adds
     # work — so it's a capacity lever, not a speed lever. Opt-in.
     kv_cache_quant: bool = False
+    # "xla" (default): dense/flash attention, GSPMD decides any resharding.
+    # "ring": exact ring attention over the sp axis — the forward must run
+    # inside shard_map with axis "sp" bound and activations sequence-sharded
+    # (train/step.py sequence_parallel=True). No-cache path only.
+    attention_impl: str = "xla"
 
     @property
     def q_dim(self) -> int:

@@ -206,6 +206,9 @@ class Attention(nn.Module):
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
 
+        if cfg.attention_impl == "ring" and cache_layer is not None:
+            raise ValueError("attention_impl='ring' supports only the no-cache path")
+
         # Shared cache write (prefill records the prompt for later decode steps).
         if cache_layer is not None:
             zero = jnp.zeros((), jnp.int32)
@@ -227,7 +230,18 @@ class Attention(nn.Module):
             keys, values = k, v
             new_cache_layer = None
 
-        if self._flash_ok(S, left_padded):
+        if cfg.attention_impl == "ring":
+            # Ring attention over the sp axis (parallel/ring.py): exact
+            # attention with each device holding a sequence shard; requires
+            # tracing inside shard_map with axis "sp" bound (training /
+            # scoring forward). GQA kv stay unexpanded on the ring.
+            from fairness_llm_tpu.parallel.ring import ring_attention
+
+            out = ring_attention(
+                q, k, v, positions, positions, key_valid,
+                axis_name="sp", window=cfg.sliding_window,
+            ).astype(dtype)
+        elif self._flash_ok(S, left_padded):
             # Training (no cache) or first prefill (cache present but empty —
             # S > 1 is the engine's static marker; a chunked-prefill caller
             # must set use_flash_attention=False). In both cases the NEW k/v

@@ -51,29 +51,42 @@ def _block_attn(
 
 def ring_attention(
     q: jnp.ndarray,  # [B, Sq_local, H, D] this device's query block
-    k: jnp.ndarray,  # [B, Sk_local, H, D] this device's key block
+    k: jnp.ndarray,  # [B, Sk_local, Hkv, D] this device's key block (GQA ok)
     v: jnp.ndarray,
     q_positions: jnp.ndarray,  # [B, Sq_local] global positions
     kv_positions: jnp.ndarray,  # [B, Sk_local]
     kv_valid: jnp.ndarray,  # [B, Sk_local] padding mask
     axis_name: str = "sp",
     causal: bool = True,
+    window: Optional[int] = None,  # sliding window over global positions
 ) -> jnp.ndarray:
-    """Exact sharded attention; call under ``shard_map`` with ``axis_name`` bound."""
+    """Exact sharded attention; call under ``shard_map`` with ``axis_name`` bound.
+
+    GQA: when k/v carry fewer heads than q, the UNEXPANDED kv blocks travel
+    the ring (Hkv x the ICI bytes, not H x) and are repeated up to H locally
+    just before each block matmul.
+    """
     axis_size = jax.lax.psum(1, axis_name)
     scale = q.shape[-1] ** -0.5
     qf = q.astype(jnp.float32)
+    rep = q.shape[2] // k.shape[2]
 
     def mask_for(kpos, kval):
         m = kval[:, None, :]
         if causal:
             m = m & (kpos[:, None, :] <= q_positions[:, :, None])
+        if window is not None:
+            m = m & ((q_positions[:, :, None] - kpos[:, None, :]) < window)
         return m
 
     def step(carry, _):
         kb, vb, kpos, kval, m_acc, l_acc, o_acc = carry
+        kx, vx = kb, vb
+        if rep > 1:  # expand GQA heads locally, after the ring hop
+            kx = jnp.repeat(kx, rep, axis=2)
+            vx = jnp.repeat(vx, rep, axis=2)
         m_blk, l_blk, o_blk = _block_attn(
-            qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+            qf, kx.astype(jnp.float32), vx.astype(jnp.float32),
             mask_for(kpos, kval), scale,
         )
         # online softmax merge

@@ -133,3 +133,90 @@ def train_loop(
         if log_every and i % log_every == 0:
             logger.info("train step %d: loss %.4f", i, losses[-1])
     return state, losses
+
+
+def make_sequence_parallel_train_step(
+    model_config: ModelConfig,
+    mesh: jax.sharding.Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+):
+    """Training step with EXPLICIT sequence parallelism: the forward runs
+    inside ``shard_map`` with activations sharded over (dp, sp) and attention
+    computed by ring passes over the sp axis (``parallel/ring.py`` via
+    ``attention_impl='ring'``) — the long-context regime where one device
+    cannot hold a full sequence's activations.
+
+    Params/optimizer state are replicated (P()); each device grads its local
+    (batch, sequence) shard and a psum over (dp, sp) completes the global
+    gradient — the collectives a DDP+context-parallel NCCL setup runs by
+    hand, here placed by shard_map.
+
+    Returns (init_state, step) like ``make_train_step``. ``step`` requires
+    batch % dp == 0 and pads the (shifted) sequence up to a multiple of sp.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    ring_config = dataclasses.replace(model_config, attention_impl="ring")
+    model = Transformer(ring_config)
+    dp = mesh.shape.get("dp", 1)
+    sp = mesh.shape.get("sp", 1)
+
+    def local_grads(params, inputs, targets, positions, avalid, tvalid):
+        def f(p):
+            logits, _ = model.apply({"params": p}, inputs, positions, avalid)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            local_sum = -jnp.sum(jnp.where(tvalid, picked, 0.0))
+            global_count = jax.lax.psum(
+                jnp.sum(tvalid, dtype=jnp.float32), ("dp", "sp")
+            )
+            return local_sum / jnp.maximum(global_count, 1.0)
+
+        loss_part, grads_part = jax.value_and_grad(f)(params)
+        loss = jax.lax.psum(loss_part, ("dp", "sp"))
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, ("dp", "sp")), grads_part)
+        return loss, grads
+
+    sharded_grads = shard_map(
+        local_grads,
+        mesh=mesh,
+        in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P("dp", "sp"),
+                  P("dp", "sp"), P("dp", "sp")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    def step(state: TrainState, tokens, valid):
+        tokens = jnp.asarray(tokens)
+        valid = jnp.asarray(valid, dtype=bool)
+        B, S = tokens.shape
+        if B % dp != 0:
+            raise ValueError(f"batch {B} must divide dp={dp}")
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        avalid = valid[:, :-1]
+        tvalid = avalid & valid[:, 1:]
+        L = inputs.shape[1]
+        pad = (-L) % sp
+        if pad:
+            inputs = jnp.pad(inputs, ((0, 0), (0, pad)))
+            targets = jnp.pad(targets, ((0, 0), (0, pad)))
+            avalid = jnp.pad(avalid, ((0, 0), (0, pad)))
+            tvalid = jnp.pad(tvalid, ((0, 0), (0, pad)))
+        positions = jnp.maximum(jnp.cumsum(avalid.astype(jnp.int32), axis=1) - 1, 0)
+
+        loss, grads = sharded_grads(
+            state.params, inputs, targets, positions, avalid, tvalid
+        )
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    def init_state(rng: jax.Array, params: Optional[Any] = None) -> TrainState:
+        if params is None:
+            params = init_params(model_config, rng)
+        opt_state = jax.jit(optimizer.init)(params)
+        return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    return init_state, jax.jit(step)

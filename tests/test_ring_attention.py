@@ -34,6 +34,51 @@ def test_ring_matches_dense(eight_device_mesh, causal):
     np.testing.assert_allclose(ring * vmask, dense * vmask, atol=1e-5, rtol=1e-5)
 
 
+def test_ring_gqa_and_window(eight_device_mesh):
+    """GQA kv (fewer heads than q) ride the ring unexpanded; sliding window
+    masks by global position — both must match the expanded dense oracle."""
+    from fairness_llm_tpu.config import MeshConfig
+    from fairness_llm_tpu.parallel import make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=1, sp=8))
+    rng = np.random.default_rng(2)
+    b, s, h, hkv, d = 1, 32, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, s, hkv, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, s, hkv, d)).astype(np.float32))
+    positions = jnp.tile(jnp.arange(s)[None, :], (b, 1))
+    valid = jnp.ones((b, s), bool)
+    window = 7
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    import functools
+
+    from fairness_llm_tpu.parallel.ring import ring_attention
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=True, window=window),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp"),
+                  P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+        check_vma=False,
+    )
+    ring = np.asarray(fn(q, k, v, positions, positions, valid))
+
+    kx = jnp.repeat(k, h // hkv, axis=2)
+    vx = jnp.repeat(v, h // hkv, axis=2)
+    scale = d ** -0.5
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, kx) * scale
+    ii = positions[:, :, None]
+    jj = positions[:, None, :]
+    mask = (jj <= ii) & ((ii - jj) < window)
+    sc = jnp.where(mask[:, None, :, :], sc, -jnp.inf)
+    p = jax.nn.softmax(sc, axis=-1)
+    dense = np.asarray(jnp.einsum("bhqk,bkhd->bqhd", p, vx))
+    np.testing.assert_allclose(ring, dense, atol=1e-5, rtol=1e-5)
+
+
 def test_ring_long_sequence(eight_device_mesh):
     """Longer sequence split 2 ways over sp (mesh sp=1 in fixture has dp=2,tp=4);
     build a dedicated sp-heavy mesh instead."""
