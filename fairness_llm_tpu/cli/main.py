@@ -87,6 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="phase-3 mitigation variant")
     p.add_argument("--strategy", default="demographic_parity",
                    choices=("demographic_parity", "equal_opportunity", "individual_fairness"))
+    p.add_argument("--calibration", default="simulated", choices=("simulated", "model"),
+                   help="phase-3 conformal confidences: reference-style simulated "
+                        "curve, or the model's own title likelihoods")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--data-dir", default=None, help="MovieLens-1M directory")
@@ -166,7 +169,8 @@ def main(argv=None) -> int:
             else:
                 p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
                                 num_profiles=args.profiles, variant=args.variant,
-                                strategy=args.strategy, save=save)
+                                strategy=args.strategy, save=save,
+                                calibration=args.calibration)
                 print_phase3_summary(p3)
                 if save:
                     from fairness_llm_tpu.reports import generate_phase3_figure

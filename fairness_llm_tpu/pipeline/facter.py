@@ -78,6 +78,32 @@ def conformal_thresholds_kernel(
     return jnp.where(n_g > 0, got, 0.5)
 
 
+@jax.jit
+def conformal_filter_mask(
+    confidences: jnp.ndarray,  # [N, K] float32, NaN-padded
+    thresholds: jnp.ndarray,  # [N] per-profile (group) thresholds
+    floor: int = 3,
+) -> jnp.ndarray:
+    """General conformal filter for NON-monotonic confidences (model-derived
+    scores, unlike the reference's rank-decreasing simulation): keep items
+    with confidence >= threshold; if fewer than ``floor`` survive, keep the
+    ``floor`` highest-confidence items instead (reference floor semantics).
+    Returns a [N, K] bool keep-mask."""
+    valid = ~jnp.isnan(confidences)
+    conf = jnp.where(valid, confidences, -jnp.inf)
+    keep = valid & (conf >= thresholds[:, None])
+    n_keep = jnp.sum(keep, axis=1)
+    # Floor fallback generalizes the reference's "first 3 by rank" (identical
+    # when confidence decreases with rank) to "top 3 by confidence". Invalid
+    # slots carry -inf so they sort last: a list shorter than ``floor`` keeps
+    # ALL its items — min(len, floor), matching conformal_keep_counts.
+    order = jnp.argsort(-conf, axis=1)
+    ranks = jnp.argsort(order, axis=1)  # rank of each item by confidence
+    top_floor = valid & (ranks < floor)
+    use_floor = n_keep < floor
+    return jnp.where(use_floor[:, None], top_floor, keep)
+
+
 def conformal_keep_counts(
     list_lengths: np.ndarray, thresholds_per_profile: np.ndarray
 ) -> np.ndarray:

@@ -34,6 +34,7 @@ from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
 from fairness_llm_tpu.pipeline.facter import (
     blended_group_fairness,
+    conformal_filter_mask,
     conformal_keep_counts,
     conformal_thresholds_kernel,
     simulate_calibration,
@@ -75,8 +76,14 @@ def apply_facter(
     variant: str = "conformal",
     settings=None,
     save_checkpoints: bool = True,
+    calibration: str = "simulated",
 ) -> Dict[str, List[str]]:
-    """Fair re-prompting + conformal filtering -> {pid: mitigated rec list}."""
+    """Fair re-prompting + conformal filtering -> {pid: mitigated rec list}.
+
+    ``calibration``: "simulated" reproduces the reference's rank-decreasing
+    confidence curve (``1 - 0.05*rank``); "model" derives each item's
+    confidence from the backend model's own likelihood of the title
+    (``runtime/scoring.py``) — requires an EngineBackend."""
     anonymize = variant in ("smart", "aggressive")
     prompts = [
         fairness_aware_prompt(
@@ -102,13 +109,45 @@ def apply_facter(
     if variant != "conformal":
         return fair_lists
 
-    # --- conformal calibration + per-gender thresholds + prefix filtering
+    # --- conformal calibration + per-gender thresholds + filtering
     pids = [p.id for p in profiles if p.id in fair_lists]
     genders = sorted({p.gender for p in profiles})
     gidx = {g: i for i, g in enumerate(genders)}
     gender_of = {p.id: p.gender for p in profiles}
     lengths = np.array([len(fair_lists[pid]) for pid in pids], dtype=np.int64)
-    conf, nonconf = simulate_calibration(lengths, seed=config.random_seed)
+
+    if calibration == "model":
+        engine = getattr(backend, "engine", None)
+        if engine is None:
+            raise ValueError("calibration='model' needs an EngineBackend")
+        from fairness_llm_tpu.runtime.scoring import score_texts
+
+        all_titles = [t for pid in pids for t in fair_lists[pid]]
+        unique_titles = sorted(set(all_titles))
+        if unique_titles:
+            sc = score_texts(engine, unique_titles)
+            lp_of = dict(zip(unique_titles, sc.mean_logprobs))
+            lp_flat = np.array([lp_of[t] for t in all_titles], np.float64)
+            # Rank-normalize likelihoods to [0, 1]: raw exp(mean_logprob)
+            # lives at ~1e-2 scale while conformal thresholds are quantiles of
+            # |conf - (conf + N(0, 0.1))| at ~0.15 scale — comparing those
+            # directly would floor-truncate every list. Percentiles put model
+            # confidence on the simulated curve's scale with the model's
+            # ORDERING intact, which is the signal that matters.
+            order = np.argsort(np.argsort(lp_flat, kind="stable"), kind="stable")
+            denom = max(len(lp_flat) - 1, 1)
+            conf = (order / denom).astype(np.float32)
+        else:
+            conf = np.zeros(0, np.float32)
+        conf_rows = np.split(conf, np.cumsum(lengths)[:-1]) if len(pids) else []
+        # Seeded simulated "actual" (no ground truth exists in either mode —
+        # reference ``phase3_facter_mitigation.py:130-137``).
+        rng = np.random.default_rng(config.random_seed)
+        actual = np.clip(conf + rng.normal(0.0, 0.1, size=conf.shape), 0.0, 1.0)
+        nonconf = np.abs(conf - actual).astype(np.float32)
+    else:
+        conf, nonconf = simulate_calibration(lengths, seed=config.random_seed)
+
     record_groups = np.concatenate(
         [np.full(n, gidx[gender_of[pid]], dtype=np.int32) for pid, n in zip(pids, lengths)]
     ) if len(pids) else np.zeros(0, np.int32)
@@ -119,6 +158,21 @@ def apply_facter(
         )
     )
     per_profile_thresh = np.array([thresholds[gidx[gender_of[pid]]] for pid in pids])
+
+    if calibration == "model":
+        k_max = int(lengths.max()) if len(lengths) else 1
+        conf_mat = np.full((len(pids), max(k_max, 1)), np.nan, np.float32)
+        for i, row in enumerate(conf_rows):
+            conf_mat[i, : len(row)] = row
+        mask = np.asarray(
+            conformal_filter_mask(jnp.asarray(conf_mat), jnp.asarray(per_profile_thresh))
+        )
+        return {
+            pid: [t for j, t in enumerate(fair_lists[pid]) if mask[i, j]]
+            for i, pid in enumerate(pids)
+        }
+
+    # simulated path: confidence decreases with rank, so the filter is a prefix
     keep = conformal_keep_counts(lengths, per_profile_thresh)
     return {pid: fair_lists[pid][: int(k)] for pid, k in zip(pids, keep)}
 
@@ -188,6 +242,7 @@ def run_phase3(
     strategy: str = "demographic_parity",
     save: bool = True,
     backend: Optional[DecodeBackend] = None,
+    calibration: str = "simulated",
 ) -> Dict:
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}")
@@ -231,7 +286,8 @@ def run_phase3(
 
     # --- mitigation
     mitigated = apply_facter(
-        profiles, backend, config, strategy, variant, settings, save_checkpoints=save
+        profiles, backend, config, strategy, variant, settings,
+        save_checkpoints=save, calibration=calibration,
     )
 
     if variant in ("smart", "aggressive"):
@@ -263,6 +319,7 @@ def run_phase3(
             "phase": 3,
             "variant": variant,
             "strategy": strategy,
+            "calibration": calibration,
             "model": backend.name,
             "num_profiles": len(profiles),
             "timestamp": time.time(),

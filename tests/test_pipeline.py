@@ -152,6 +152,58 @@ def test_conformal_thresholds_match_numpy():
         np.testing.assert_allclose(out[g], s[idx], atol=1e-6)
 
 
+def test_conformal_filter_mask_general():
+    from fairness_llm_tpu.pipeline.facter import conformal_filter_mask
+
+    conf = np.array(
+        [[0.9, 0.2, 0.8, np.nan],     # threshold .5 -> keep {0, 2}, floor kicks in (2 < 3)? n_keep=2 -> top-3 by conf = {0,2,1}
+         [0.9, 0.8, 0.7, 0.6]],       # threshold .5 -> keep all 4
+        np.float32,
+    )
+    thresholds = np.array([0.5, 0.5], np.float32)
+    mask = np.asarray(conformal_filter_mask(jnp.asarray(conf), jnp.asarray(thresholds)))
+    assert mask[0].tolist() == [True, True, True, False]  # floor-3 by confidence
+    assert mask[1].tolist() == [True, True, True, True]
+
+
+def test_phase3_model_calibration(config):
+    """calibration='model' uses the engine's title likelihoods end to end."""
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.pipeline.backends import EngineBackend
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    eng_backend = EngineBackend(DecodeEngine(get_model_config("tiny-test"), seed=0),
+                                name="tiny-test")
+    sim = SimulatedRecommender(
+        [f"Movie {i}" for i in range(40)], seed=config.random_seed, bias=0.8
+    )
+    p1 = run_phase1(config, model_name="simulated", backend=sim, save=False)
+    # hybrid: phase-1 recs from the simulator (parseable), calibration scored
+    # by the real engine
+
+    class Hybrid:
+        name = "hybrid"
+        engine = eng_backend.engine
+
+        def generate(self, prompts, settings=None, seed=0, keys=None):
+            return sim.generate(prompts, settings, seed, keys)
+
+    res = run_phase3(config, phase1_results=p1, model_name="simulated",
+                     backend=Hybrid(), variant="conformal", save=False,
+                     calibration="model")
+    assert res["metadata"]["calibration"] == "model"
+    assert res["quality_preservation"]["num_comparisons"] == 45
+    mit = res["mitigated_recommendations"]
+    # floor respected
+    assert all(len(v) >= 3 for v in mit.values())
+    # and the filter actually DISCRIMINATES on model likelihoods — it must not
+    # degenerate to floor-3 truncation everywhere (the scale-mismatch failure
+    # mode): most lists keep more than the floor, and some items are dropped
+    lens = [len(v) for v in mit.values()]
+    assert max(lens) > 3
+    assert sum(lens) < 45 * 10  # at least one item filtered out
+
+
 def test_conformal_keep_is_prefix_with_floor():
     lengths = np.array([10, 10, 2, 10])
     thresholds = np.array([0.0, 0.8, 0.0, 1.0])
