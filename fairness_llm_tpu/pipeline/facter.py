@@ -51,9 +51,16 @@ def simulate_calibration(
         else np.zeros(0)
     )
     conf = 1.0 - 0.05 * ranks
+    return conf.astype(np.float32), nonconformity_from_confidence(conf, seed)
+
+
+def nonconformity_from_confidence(conf: np.ndarray, seed: int) -> np.ndarray:
+    """|conf - simulated actual| with seeded N(0, 0.1) noise — the one shared
+    definition for both calibration modes (ground truth exists in neither;
+    the reference simulates it unseeded, ``phase3_facter_mitigation.py:130-137``)."""
     rng = np.random.default_rng(seed)
     actual = np.clip(conf + rng.normal(0.0, 0.1, size=conf.shape), 0.0, 1.0)
-    return conf.astype(np.float32), np.abs(conf - actual).astype(np.float32)
+    return np.abs(conf - actual).astype(np.float32)
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups",))

@@ -37,6 +37,7 @@ from fairness_llm_tpu.pipeline.facter import (
     conformal_filter_mask,
     conformal_keep_counts,
     conformal_thresholds_kernel,
+    nonconformity_from_confidence,
     simulate_calibration,
     smart_balance,
 )
@@ -140,11 +141,7 @@ def apply_facter(
         else:
             conf = np.zeros(0, np.float32)
         conf_rows = np.split(conf, np.cumsum(lengths)[:-1]) if len(pids) else []
-        # Seeded simulated "actual" (no ground truth exists in either mode —
-        # reference ``phase3_facter_mitigation.py:130-137``).
-        rng = np.random.default_rng(config.random_seed)
-        actual = np.clip(conf + rng.normal(0.0, 0.1, size=conf.shape), 0.0, 1.0)
-        nonconf = np.abs(conf - actual).astype(np.float32)
+        nonconf = nonconformity_from_confidence(conf, config.random_seed)
     else:
         conf, nonconf = simulate_calibration(lengths, seed=config.random_seed)
 
@@ -246,6 +243,11 @@ def run_phase3(
 ) -> Dict:
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}")
+    if calibration == "model" and variant != "conformal":
+        # smart/aggressive re-rank without conformal filtering, so model
+        # calibration would be silently ignored — refuse instead of
+        # misrecording it in the results metadata.
+        raise ValueError("calibration='model' applies only to variant='conformal'")
     config = config or default_config()
     model_name = model_name or config.default_model_phase3
     t0 = time.time()
