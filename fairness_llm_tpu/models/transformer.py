@@ -185,7 +185,13 @@ class Attention(nn.Module):
         key_valid: jnp.ndarray,  # [B, K] for the post-update key set
         key_positions: jnp.ndarray,  # [B, K]
         left_padded: bool = False,
+        shared_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
     ):
+        # ``shared_kv``: (k, v) each [Pc, Hkv, D] — a prompt prefix COMMON to
+        # every batch row, computed once and read once per step instead of
+        # B times (prefix caching; decode is KV-read-bound). Shared keys sit
+        # at global positions 0..Pc-1, strictly before every query, so they
+        # are always causally visible; per-row positions are offset by Pc.
         cfg = self.config
         dtype = _dtype_of(cfg)
         dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
@@ -241,7 +247,7 @@ class Attention(nn.Module):
                 q, k, v, positions, positions, key_valid,
                 axis_name="sp", window=cfg.sliding_window,
             ).astype(dtype)
-        elif self._flash_ok(S, left_padded):
+        elif shared_kv is None and self._flash_ok(S, left_padded):
             # Training (no cache) or first prefill (cache present but empty —
             # S > 1 is the engine's static marker; a chunked-prefill caller
             # must set use_flash_attention=False). In both cases the NEW k/v
@@ -282,8 +288,25 @@ class Attention(nn.Module):
             scale = cfg.head_dim ** -0.5
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, dense_keys).astype(jnp.float32) * scale
             scores = jnp.where(allowed[:, None, :, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, dense_values)
+            if shared_kv is not None:
+                sk, sv = shared_kv  # [Pc, Hkv, D]
+                if rep > 1:
+                    sk = jnp.repeat(sk, rep, axis=1)
+                    sv = jnp.repeat(sv, rep, axis=1)
+                # [B,H,S,Pc] — note sk has no batch dim: read once, not B times
+                s_sh = jnp.einsum("bqhd,khd->bhqk", q, sk.astype(q.dtype)).astype(jnp.float32) * scale
+                if cfg.sliding_window is not None:
+                    sh_pos = jnp.arange(sk.shape[0])
+                    sh_allowed = (positions[:, :, None] - sh_pos[None, None, :]) < cfg.sliding_window
+                    s_sh = jnp.where(sh_allowed[:, None, :, :], s_sh, -1e30)
+                joint = jnp.concatenate([s_sh, scores], axis=-1)
+                probs = jax.nn.softmax(joint, axis=-1).astype(dtype)
+                p_sh, p_own = probs[..., : sk.shape[0]], probs[..., sk.shape[0]:]
+                out = jnp.einsum("bhqk,khd->bqhd", p_sh, sv.astype(dtype))
+                out = out + jnp.einsum("bhqk,bkhd->bqhd", p_own, dense_values)
+            else:
+                probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+                out = jnp.einsum("bhqk,bkhd->bqhd", probs, dense_values)
 
         out = out.reshape(B, S, cfg.q_dim)
         out = nn.DenseGeneral(
@@ -325,11 +348,11 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, cache_layer, cache_index, key_valid, key_positions,
-                 left_padded=False):
+                 left_padded=False, shared_kv=None):
         attn_out, new_cache = Attention(self.config, name="attn")(
             _norm(self.config, "attn_norm")(x),
             positions, cache_layer, cache_index, key_valid, key_positions,
-            left_padded=left_padded,
+            left_padded=left_padded, shared_kv=shared_kv,
         )
         x = x + attn_out
         x = x + MLP(self.config, name="mlp")(_norm(self.config, "mlp_norm")(x))
@@ -362,6 +385,7 @@ class Transformer(nn.Module):
         cache: Optional[KVCache] = None,
         left_padded: bool = False,  # promise: valid tokens occupy trailing slots
         last_only: bool = False,  # return logits for the final position only
+        shared_layers: Optional[Tuple] = None,  # per-layer (k, v) [Pc, Hkv, D] prefix KV
     ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
         cfg = self.config
         dtype = _dtype_of(cfg)
@@ -408,6 +432,7 @@ class Transformer(nn.Module):
                 x, positions,
                 layer_cache, cache.index if cache is not None else None,
                 key_valid, key_positions, left_padded=left_padded,
+                shared_kv=shared_layers[i] if shared_layers is not None else None,
             )
             new_layers.append(new_layer)
 

@@ -41,6 +41,7 @@ class DecodeBackend(Protocol):
         settings: Optional[ModelSettings] = None,
         seed: int = 0,
         keys: Optional[Sequence[str]] = None,
+        prefix_ids: Optional[Sequence[int]] = None,
     ) -> List[str]:
         ...
 
@@ -58,13 +59,36 @@ class EngineBackend:
         settings: Optional[ModelSettings] = None,
         seed: int = 0,
         keys: Optional[Sequence[str]] = None,
+        prefix_ids: Optional[Sequence[int]] = None,
     ) -> List[str]:
         row_seeds = None
         if keys is not None:
             # Per-row sampling streams keyed on stable identity, so resumed /
             # re-chunked sweeps decode identical text for the same profile.
             row_seeds = [(_stable_hash(k) ^ seed) & 0xFFFFFFFF for k in keys]
-        return self.engine.generate(prompts, settings, seed=seed, row_seeds=row_seeds).texts
+        return self.engine.generate(
+            prompts, settings, seed=seed, row_seeds=row_seeds,
+            prefix_ids=prefix_ids,
+            # sweeps pass an explicit sweep-wide prefix; never auto-detect per
+            # chunk (composition-dependent — see engine.generate docstring)
+            share_prefix=None if prefix_ids is not None else False,
+        ).texts
+
+
+def shared_prefix_ids(backend, prompts: Sequence[str]) -> Optional[List[int]]:
+    """Sweep-wide shared prefix for reproducible prefix-cached decode: the
+    longest common token prefix over ALL the sweep's prompts, floored to a
+    multiple of 64 (compile-shape reuse). None for non-engine backends or
+    short prefixes. Computing this once over the full sweep — instead of per
+    chunk — keeps resumed runs numerically identical to uninterrupted ones."""
+    engine = getattr(backend, "engine", None)
+    if engine is None or len(prompts) < 2:
+        return None
+    from fairness_llm_tpu.runtime.engine import _token_lcp
+
+    rows = [engine.tokenizer.encode(p) for p in prompts]
+    common = (_token_lcp(rows) // 64) * 64
+    return list(rows[0][:common]) if common >= 64 else None
 
 
 def _stable_hash(*parts: object) -> int:
@@ -145,6 +169,7 @@ class SimulatedRecommender:
         settings: Optional[ModelSettings] = None,
         seed: int = 0,
         keys: Optional[Sequence[str]] = None,
+        prefix_ids: Optional[Sequence[int]] = None,  # unused: text-level sim
     ) -> List[str]:
         # Entropy per prompt = (seed, prompt hash, stable key) — NOT batch
         # position — so outputs don't depend on how the sweep was chunked or

@@ -59,9 +59,13 @@ def decode_sweep(
     Returns {key: {recommendations, raw_response}} in input order, reusing
     entries already present in ``done`` (resume path).
     """
+    from fairness_llm_tpu.pipeline.backends import shared_prefix_ids
     from fairness_llm_tpu.utils import with_failure_containment
 
     generate = with_failure_containment(backend.generate)
+    # Prefix-cache key computed over the FULL sweep (not per chunk), so
+    # resumed and uninterrupted runs split attention identically.
+    prefix_ids = shared_prefix_ids(backend, list(prompts))
     done = dict(done or {})
     chunk = max(config.decode_batch_size, 1)
     # Chunk over ABSOLUTE positions in the full prompt list (not the remaining
@@ -80,6 +84,7 @@ def decode_sweep(
             settings,
             seed=config.random_seed + start,
             keys=[k for k, _ in batch],
+            prefix_ids=prefix_ids,
         )
         for (k, _), text in zip(batch, texts):
             if text is None:  # contained decode failure — see utils/failures.py

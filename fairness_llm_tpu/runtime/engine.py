@@ -55,6 +55,19 @@ def _bucket_len(n: int, multiple: int = 64) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def _token_lcp(rows) -> int:
+    """Longest common token prefix across rows, capped so that every row
+    keeps at least one non-prefix token."""
+    if not rows:
+        return 0
+    limit = min(len(r) for r in rows) - 1
+    common = 0
+    first = rows[0]
+    while common < limit and all(r[common] == first[common] for r in rows):
+        common += 1
+    return common
+
+
 def _bucket_batch(n: int, mesh: Optional[jax.sharding.Mesh] = None) -> int:
     # Multiples of 8 (sublane granularity), not powers of two: decode steps
     # stream the whole [B, max_len] KV cache from HBM, so padding 45 -> 64
@@ -116,8 +129,45 @@ class DecodeEngine:
 
     # -- compiled program ---------------------------------------------------
 
-    def _decode_fn(self, batch: int, prompt_len: int, max_new: int, sampler_settings: SamplerSettings):
-        key = (batch, prompt_len, max_new, sampler_settings)
+    def _prefix_fn(self, prefix_len: int):
+        """Compiled forward over the shared prompt prefix [1, Pc] -> per-layer
+        (k, v) arrays [Pc, Hkv, D] every batch row reads (but never copies)."""
+        key = ("prefix", prefix_len)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        model = self.model
+
+        def run(params, tokens):
+            positions = jnp.arange(prefix_len, dtype=jnp.int32)[None, :]
+            cache = init_cache(cfg, 1, prefix_len)
+            _, cache = model.apply(
+                {"params": params}, tokens, positions,
+                jnp.ones((1, prefix_len), jnp.bool_), cache,
+                left_padded=True, last_only=True,
+            )
+            out = []
+            for layer in cache.layers:
+                if cfg.kv_cache_quant:
+                    from fairness_llm_tpu.models.transformer import _dequantize_kv
+
+                    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+                    out.append((
+                        _dequantize_kv(layer.k, layer.k_scale, dtype)[0],
+                        _dequantize_kv(layer.v, layer.v_scale, dtype)[0],
+                    ))
+                else:
+                    out.append((layer.k[0], layer.v[0]))
+            return tuple(out)
+
+        fn = jax.jit(run)
+        self._compiled[key] = fn
+        return fn
+
+    def _decode_fn(self, batch: int, prompt_len: int, max_new: int,
+                   sampler_settings: SamplerSettings, prefix_len: int = 0):
+        key = (batch, prompt_len, max_new, sampler_settings, prefix_len)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -128,13 +178,15 @@ class DecodeEngine:
         pad_id = self.tokenizer.pad_id
         eos_id = self.tokenizer.eos_id
 
-        def run(params, tokens, valid, row_seeds, row_live):
-            # positions: 0..len-1 over real tokens; pad slots clamped to 0
-            positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
+        def run(params, tokens, valid, row_seeds, row_live, shared_layers):
+            # positions: global (prefix offset + 0..len-1); pad slots clamped
+            positions = prefix_len + jnp.maximum(
+                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
+            )
             cache = init_cache(cfg, batch, prompt_len + max_new)
             logits, cache = model.apply(
                 {"params": params}, tokens, positions, valid, cache,
-                left_padded=True, last_only=True,
+                left_padded=True, last_only=True, shared_layers=shared_layers,
             )
             last_logits = logits[:, -1, :]
             # One independent key stream per row, derived from that row's seed
@@ -161,13 +213,14 @@ class DecodeEngine:
                 )
                 done_next = done | (tok == eos_id)
                 step_valid = ~done  # the just-sampled token is real iff row was live
-                pos = cache.lengths[:, None]
+                pos = prefix_len + cache.lengths[:, None]
                 logits, cache = model.apply(
                     {"params": params},
                     tok[:, None],
                     pos,
                     step_valid[:, None],
                     cache,
+                    shared_layers=shared_layers,
                 )
                 return (step_idx + 1, cache, logits[:, -1, :], done_next, toks)
 
@@ -178,6 +231,7 @@ class DecodeEngine:
             _, _, _, _, toks = jax.lax.while_loop(cond, body, init)
             return toks  # [B, max_new]
 
+        # shared_layers is a pytree arg: None (empty pytree) when no prefix.
         fn = jax.jit(run)
         self._compiled[key] = fn
         return fn
@@ -191,6 +245,8 @@ class DecodeEngine:
         max_new_tokens: Optional[int] = None,
         seed: int = 0,
         row_seeds: Optional[Sequence[int]] = None,
+        share_prefix: Optional[bool] = None,
+        prefix_ids: Optional[Sequence[int]] = None,
     ) -> GenerateOutput:
         """Decode a batch of prompts; returns detokenized continuations.
 
@@ -213,12 +269,64 @@ class DecodeEngine:
             )
         prompt_budget = self.config.max_seq_len - max_new
         n = len(prompts)
-        tb = self.tokenizer.encode_batch(prompts)
-        prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget), self.seq_bucket)
-        if prompt_len > prompt_budget:
-            prompt_len = prompt_budget
-        if tb.tokens.shape[1] > prompt_len:
-            tb = self.tokenizer.encode_batch(prompts, max_len=prompt_len)
+
+        # Shared-prefix decode: the counterfactual sweep's prompts are
+        # near-identical, so their longest common TOKEN prefix is most of the
+        # prompt. Compute its KV once [Pc, Hkv, D] instead of per-row —
+        # decode is KV-read-bound, so a shared 80% prefix cuts that traffic
+        # by ~0.8*(1 - 1/B).
+        #
+        # ``prefix_ids`` (explicit, from the caller) is the reproducible way:
+        # pipelines compute the prefix over the FULL sweep once, so resumed /
+        # re-chunked batches split attention identically. Auto-detection
+        # (share_prefix=None/True without prefix_ids) is composition-
+        # DEPENDENT: near-tie sampled tokens can differ between a batch and
+        # its resume-subset — fine for one-shot calls, not for sweeps.
+        from fairness_llm_tpu.models.tokenizer import _left_pad
+
+        rows = [self.tokenizer.encode(p) for p in prompts]
+        shared_ids: Optional[list] = None
+        if share_prefix is not False and n >= 1 and prefix_ids is not None:
+            pl = list(prefix_ids)
+            if all(r[: len(pl)] == pl for r in rows):
+                shared_ids = pl
+            else:
+                logger.warning("prefix_ids is not a prefix of every prompt; sharing disabled")
+        elif share_prefix is not False and n >= 2 and prefix_ids is None:
+            common = _token_lcp(rows)
+            min_shared = 64 if share_prefix is None else 1
+            if common >= min_shared:
+                shared_ids = rows[0][:common]
+
+        if shared_ids is not None:
+            # Budget: the prefix must never crowd out per-row remainders (the
+            # demographics the sweep varies). Shrink the prefix until every
+            # full remainder fits, then floor to a multiple of 64 so distinct
+            # prefix lengths land on shared compiled programs.
+            max_rem = max(len(r) - len(shared_ids) for r in rows)
+            over = max_rem - (prompt_budget - len(shared_ids))
+            if over > 0:
+                shared_ids = shared_ids[: max(len(shared_ids) - over, 0)]
+            shared_ids = shared_ids[: (len(shared_ids) // 64) * 64]
+            if not shared_ids:
+                shared_ids = None
+
+        if shared_ids is not None:
+            remainders = [r[len(shared_ids):] for r in rows]
+            rem_budget = prompt_budget - len(shared_ids)
+            tb = _left_pad(remainders, self.tokenizer.pad_id)
+            prompt_len = _bucket_len(min(tb.tokens.shape[1], rem_budget), 64)
+            if prompt_len > rem_budget:
+                prompt_len = max(rem_budget, 1)
+            if tb.tokens.shape[1] > prompt_len:
+                tb = _left_pad(remainders, self.tokenizer.pad_id, max_len=prompt_len)
+        else:
+            tb = _left_pad(rows, self.tokenizer.pad_id)
+            prompt_len = _bucket_len(min(tb.tokens.shape[1], prompt_budget), self.seq_bucket)
+            if prompt_len > prompt_budget:
+                prompt_len = prompt_budget
+            if tb.tokens.shape[1] > prompt_len:
+                tb = _left_pad(rows, self.tokenizer.pad_id, max_len=prompt_len)
         batch = _bucket_batch(n, self.mesh)
         tokens = np.full((batch, prompt_len), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((batch, prompt_len), dtype=bool)
@@ -240,7 +348,13 @@ class DecodeEngine:
             row_seeds_arr = np.zeros(batch, dtype=np.uint32)
             row_seeds_arr[:n] = np.asarray(row_seeds, dtype=np.uint64).astype(np.uint32)
 
-        fn = self._decode_fn(batch, prompt_len, max_new, sampler)
+        prefix_len = len(shared_ids) if shared_ids is not None else 0
+        shared_layers = None
+        if prefix_len:
+            pfn = self._prefix_fn(prefix_len)
+            shared_layers = pfn(self.params, jnp.asarray(shared_ids, jnp.int32)[None, :])
+
+        fn = self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
         tokens_j = jnp.asarray(tokens)
         valid_j = jnp.asarray(valid)
         if self.mesh is not None:
@@ -257,9 +371,9 @@ class DecodeEngine:
         live_j = jnp.asarray(live)
         if ctx_mesh is not None:
             with ctx_mesh, nn.logical_axis_rules(self.rules):
-                out = fn(self.params, tokens_j, valid_j, seeds_j, live_j)
+                out = fn(self.params, tokens_j, valid_j, seeds_j, live_j, shared_layers)
         else:
-            out = fn(self.params, tokens_j, valid_j, seeds_j, live_j)
+            out = fn(self.params, tokens_j, valid_j, seeds_j, live_j, shared_layers)
         out = np.asarray(jax.device_get(out))[:n]
 
         texts = []

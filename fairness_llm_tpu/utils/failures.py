@@ -32,12 +32,15 @@ def with_failure_containment(
     sentinels (``phase1_bias_detection.py:202-211``)."""
 
     def wrapped(
-        prompts: Sequence[str], settings=None, seed: int = 0, keys=None
+        prompts: Sequence[str], settings=None, seed: int = 0, keys=None,
+        prefix_ids=None,
     ) -> List[Optional[str]]:
         last: Optional[Exception] = None
         for attempt in range(retries + 1):
             try:
-                return list(generate(prompts, settings, seed=seed, keys=keys))
+                return list(generate(
+                    prompts, settings, seed=seed, keys=keys, prefix_ids=prefix_ids
+                ))
             except Exception as e:  # noqa: BLE001 — containment is the point
                 last = e
                 logger.warning(

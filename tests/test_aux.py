@@ -41,7 +41,7 @@ def test_restore_empty_dir_returns_none(tmp_path):
 def test_failure_containment_retries_then_sentinels(caplog):
     calls = []
 
-    def flaky(prompts, settings=None, seed=0, keys=None):
+    def flaky(prompts, settings=None, seed=0, keys=None, prefix_ids=None):
         calls.append(1)
         raise RuntimeError("device exploded")
 
@@ -53,7 +53,7 @@ def test_failure_containment_retries_then_sentinels(caplog):
 
 
 def test_failure_containment_passthrough():
-    def ok(prompts, settings=None, seed=0, keys=None):
+    def ok(prompts, settings=None, seed=0, keys=None, prefix_ids=None):
         return [p.upper() for p in prompts]
 
     assert with_failure_containment(ok)(["hi"]) == ["HI"]

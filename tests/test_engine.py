@@ -79,6 +79,56 @@ def test_row_seeds_make_sampling_composition_independent(engine):
     np.testing.assert_array_equal(solo.tokens[0], mixed.tokens[1])
 
 
+def test_shared_prefix_decode_matches_plain(engine):
+    """Prefix-cached decode must be EXACTLY the same computation as plain
+    decode (same keys/values, same masks) — greedy tokens identical."""
+    g = ModelSettings(temperature=0.0, max_tokens=16)
+    common = "shared instruction block " * 8
+    prompts = [common + f"user {i} tail" for i in range(5)]
+    plain = engine.generate(prompts, g, share_prefix=False)
+    shared = engine.generate(prompts, g, share_prefix=True)
+    np.testing.assert_array_equal(plain.tokens, shared.tokens)
+
+
+def test_shared_prefix_auto_threshold(engine):
+    """Auto mode only engages for long common prefixes; short ones decode
+    identically through the plain path."""
+    g = ModelSettings(temperature=0.0, max_tokens=8)
+    prompts = ["ab one", "ab two", "ab three"]  # tiny common prefix
+    auto = engine.generate(prompts, g)  # share_prefix=None -> auto
+    plain = engine.generate(prompts, g, share_prefix=False)
+    np.testing.assert_array_equal(auto.tokens, plain.tokens)
+
+
+def test_engine_sweep_resume_reproducible_with_prefix(engine, tmp_path):
+    """decode_sweep on a REAL engine backend with prefix caching: a resumed
+    run must reproduce the uninterrupted run exactly — the sweep-wide
+    prefix_ids keep the attention split identical across chunk compositions."""
+    from fairness_llm_tpu.config import Config
+    from fairness_llm_tpu.pipeline import results as R
+    from fairness_llm_tpu.pipeline.backends import EngineBackend
+    from fairness_llm_tpu.pipeline.phase1 import decode_sweep
+
+    backend = EngineBackend(engine, name="tiny-test")
+    common = "identical instruction preamble repeated for every row " * 4
+    prompts = [common + f"row {i}" for i in range(10)]
+    keys = [f"k{i}" for i in range(10)]
+    settings = ModelSettings(temperature=0.9, max_tokens=10)  # sampled, not greedy
+    cfg_a = Config(results_dir=str(tmp_path / "a"), decode_batch_size=4,
+                   checkpoint_every=4)
+    full = decode_sweep(backend, prompts, keys, cfg_a, "phase1", settings=settings)
+
+    cfg_b = Config(results_dir=str(tmp_path / "b"), decode_batch_size=4,
+                   checkpoint_every=4)
+    partial = {k: full[k] for k in keys[:3]}  # interrupt mid-first-chunk
+    R.save_checkpoint(partial, cfg_b.results_dir, "phase1", 3)
+    done = R.load_latest_checkpoint(cfg_b.results_dir, "phase1")
+    resumed = decode_sweep(backend, prompts, keys, cfg_b, "phase1",
+                           done=done, settings=settings)
+    for k in keys:
+        assert resumed[k]["raw_response"] == full[k]["raw_response"], k
+
+
 def test_sharded_decode_matches_unsharded(engine, eight_device_mesh):
     """dp=2 x tp=4 sharded decode reproduces single-device greedy output."""
     cfg = get_model_config("tiny-test")

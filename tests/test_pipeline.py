@@ -59,9 +59,9 @@ def test_phase1_resume_skips_done(config, backend, monkeypatch):
     calls = []
     orig = backend.generate
 
-    def counting(prompts, settings=None, seed=0, keys=None):
+    def counting(prompts, settings=None, seed=0, keys=None, prefix_ids=None):
         calls.append(len(prompts))
-        return orig(prompts, settings, seed, keys)
+        return orig(prompts, settings, seed, keys, prefix_ids)
 
     monkeypatch.setattr(backend, "generate", counting)
     run_phase1(config, model_name="simulated", backend=backend, save=False, resume=True)
@@ -185,7 +185,7 @@ def test_phase3_model_calibration(config):
         name = "hybrid"
         engine = eng_backend.engine
 
-        def generate(self, prompts, settings=None, seed=0, keys=None):
+        def generate(self, prompts, settings=None, seed=0, keys=None, prefix_ids=None):
             return sim.generate(prompts, settings, seed, keys)
 
     res = run_phase3(config, phase1_results=p1, model_name="simulated",
