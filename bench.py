@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -56,6 +57,13 @@ def main() -> None:
 
     model_name = os.environ.get("BENCH_MODEL", "gpt2-small")
     config = get_model_config(model_name)
+    if os.environ.get("BENCH_KV_QUANT") == "1":
+        # int8 KV cache: the capacity lever that fits 3B-class models' caches
+        # on one chip (see models/configs.py kv_cache_quant).
+        import dataclasses
+
+        config = dataclasses.replace(config, kv_cache_quant=True)
+        model_name += "+int8kv"
     prompts = build_sweep_prompts()
     settings = ModelSettings(temperature=0.7, top_k=0, top_p=1.0, max_tokens=MAX_NEW_TOKENS)
 
@@ -73,14 +81,20 @@ def main() -> None:
         jax.block_until_ready(out.tokens)
         times.append(time.perf_counter() - t0)
 
-    # Large-sweep throughput: decode is weight-streaming-bound at batch 64, so
-    # a thousands-of-profiles ML-1M sweep runs at the batch-256 rate instead.
-    big = list(prompts) * 4
-    engine.generate(big, settings, seed=0)
-    t0 = time.perf_counter()
-    out_big = engine.generate(big, settings, seed=99)
-    jax.block_until_ready(out_big.tokens)
-    big_rate = len(big) / (time.perf_counter() - t0)
+    # Large-sweep throughput: decode is weight-streaming-bound at small batch,
+    # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
+    # instead. Big models can OOM at this batch on one chip — report null
+    # rather than failing the whole benchmark.
+    big_rate = None
+    try:
+        big = list(prompts) * 4
+        engine.generate(big, settings, seed=0)
+        t0 = time.perf_counter()
+        out_big = engine.generate(big, settings, seed=99)
+        jax.block_until_ready(out_big.tokens)
+        big_rate = len(big) / (time.perf_counter() - t0)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"large-sweep measurement skipped: {type(e).__name__}", file=sys.stderr)
 
     best = min(times)
     # The decode program runs on a single chip (no mesh in this bench), so
@@ -99,7 +113,7 @@ def main() -> None:
             "decode_tokens_per_sec": round(tokens_per_sec, 1),
             "best_wall_s": round(best, 3),
             "all_wall_s": [round(t, 3) for t in times],
-            "large_sweep_profiles_per_sec": round(big_rate, 3),
+            "large_sweep_profiles_per_sec": round(big_rate, 3) if big_rate else None,
             "baseline": "reference README: ~15 min for the 45-profile sweep via API",
         },
     }

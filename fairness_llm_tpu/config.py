@@ -82,6 +82,8 @@ class Config:
         ("tiny-test", ModelSettings(temperature=0.7, max_tokens=128)),
         ("tiny-gpt2", ModelSettings(temperature=0.7, max_tokens=128)),
         ("gpt2-small", ModelSettings(temperature=0.7, max_tokens=256)),
+        ("llama32-1b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("llama32-3b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("llama3-8b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("llama3-70b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("mistral-7b", ModelSettings(temperature=0.7, max_tokens=500)),

@@ -64,6 +64,16 @@ class ModelConfig:
     def kv_dim(self) -> int:
         return self.num_kv_heads * self.head_dim
 
+    @property
+    def approx_param_count(self) -> int:
+        """Parameter-count estimate from the architecture constants."""
+        embed = self.vocab_size * self.d_model
+        attn = self.d_model * (2 * self.q_dim + 2 * self.kv_dim)
+        mlp_in = 2 if self.mlp == "glu" else 1
+        mlp = self.d_model * self.d_ff * (mlp_in + 1)
+        head = 0 if self.tie_embeddings else embed
+        return embed + head + self.num_layers * (attn + mlp)
+
 
 MODEL_CONFIGS = {
     # Tiny config for tests/CI: fast to init, exercises GQA + RoPE + GLU path.
@@ -85,6 +95,20 @@ MODEL_CONFIGS = {
         pos_emb="learned", norm="layernorm", mlp="mlp", use_bias=True,
         activation="gelu_tanh", tie_embeddings=True, eos_token_id=50256,
         pad_token_id=50256,
+    ),
+    # Llama-3.2 small models: the single-chip-friendly members of the family
+    # (1B/3B fit a v5e chip in bf16 with room for KV cache and batch).
+    "llama32-1b": ModelConfig(
+        name="llama32-1b", vocab_size=128256, num_layers=16, num_heads=32,
+        num_kv_heads=8, d_model=2048, d_ff=8192, head_dim=64, max_seq_len=8192,
+        rope_theta=500000.0, tie_embeddings=True, eos_token_id=128001,
+        pad_token_id=128001,
+    ),
+    "llama32-3b": ModelConfig(
+        name="llama32-3b", vocab_size=128256, num_layers=28, num_heads=24,
+        num_kv_heads=8, d_model=3072, d_ff=8192, head_dim=128, max_seq_len=8192,
+        rope_theta=500000.0, tie_embeddings=True, eos_token_id=128001,
+        pad_token_id=128001,
     ),
     "llama3-8b": ModelConfig(
         name="llama3-8b", vocab_size=128256, num_layers=32, num_heads=32,

@@ -476,6 +476,37 @@ class Transformer(nn.Module):
         return logits, new_cache
 
 
+def init_params_lowmem(config: ModelConfig, rng: jax.Array, dtype=None) -> Any:
+    """Random params WITHOUT materializing the float32 init tree.
+
+    ``flax`` init allocates every param in float32 (param_dtype default); for a
+    multi-billion-param model that transient f32 tree alone can exceed one
+    chip's HBM. This path gets shapes from ``jax.eval_shape`` (no memory) and
+    fills each leaf directly in the target dtype: kernels/embeddings ~ N(0,
+    0.02), biases zero, norm scales one — the same families as the real init
+    (exact distribution parity is irrelevant for random-weight use).
+    """
+    dtype = dtype or (jnp.bfloat16 if config.dtype == "bfloat16" else jnp.float32)
+    model = Transformer(config)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    positions = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.key(0), tokens, positions)
+    abstract = nn.meta.unbox(abstract["params"])
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+    leaves = []
+    for i, (path, leaf) in enumerate(flat):
+        name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        key = jax.random.fold_in(rng, i)
+        if name.endswith("scale"):
+            leaves.append(jnp.ones(leaf.shape, dtype))
+        elif name.endswith("bias"):
+            leaves.append(jnp.zeros(leaf.shape, dtype))
+        else:
+            leaves.append((jax.random.normal(key, leaf.shape, dtype) * 0.02))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def init_params(config: ModelConfig, rng: jax.Array, seq_len: int = 8) -> Any:
     """Initialize parameters with a tiny dummy batch (shape doesn't matter for params).
 
