@@ -392,6 +392,10 @@ class DecodeEngine:
             # and compute it under the same mesh/rules context as decode.
             kv_key = ("prefix_kv", tuple(shared_ids))
             shared_layers = self._compiled.get(kv_key)
+            if shared_layers is not None:
+                # LRU refresh: without it a recurring sweep prefix stays
+                # oldest-inserted and one-off prefixes evict it.
+                self._compiled[kv_key] = self._compiled.pop(kv_key)
             if shared_layers is None:
                 pfn = self._prefix_fn(prefix_len)
                 ids_j = jnp.asarray(shared_ids, jnp.int32)[None, :]
@@ -400,6 +404,12 @@ class DecodeEngine:
                         shared_layers = pfn(self.params, ids_j)
                 else:
                     shared_layers = pfn(self.params, ids_j)
+                # Each cached prefix KV holds device memory (layers x [Pc, H, D]);
+                # evict the oldest beyond a small working set so a long-lived
+                # engine serving many different sweeps doesn't accumulate HBM.
+                kv_keys = [k for k in self._compiled if k[0] == "prefix_kv"]
+                while len(kv_keys) >= 4:
+                    del self._compiled[kv_keys.pop(0)]
                 self._compiled[kv_key] = shared_layers
 
         seeds_j = jnp.asarray(row_seeds_arr)

@@ -129,6 +129,16 @@ def test_engine_sweep_resume_reproducible_with_prefix(engine, tmp_path):
         assert resumed[k]["raw_response"] == full[k]["raw_response"], k
 
 
+def test_prefix_kv_cache_bounded(engine):
+    """The per-sweep prefix-KV cache must not grow without bound."""
+    g = ModelSettings(temperature=0.0, max_tokens=4)
+    for i in range(6):
+        common = f"sweep {i} preamble " * 12
+        engine.generate([common + "a", common + "b"], g, share_prefix=True)
+    kv_entries = [k for k in engine._compiled if k[0] == "prefix_kv"]
+    assert 1 <= len(kv_entries) <= 4
+
+
 def test_sharded_decode_matches_unsharded(engine, eight_device_mesh):
     """dp=2 x tp=4 sharded decode reproduces single-device greedy output."""
     cfg = get_model_config("tiny-test")

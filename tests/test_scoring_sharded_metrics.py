@@ -42,6 +42,17 @@ def test_perplexity_by_model(engine):
     assert ppl["tiny"] > 1.0 and np.isfinite(ppl["tiny"])
 
 
+def test_score_texts_on_mesh(engine, eight_device_mesh):
+    """Sharded scoring path: dp/tp mesh, results match the single-device LLs."""
+    sharded = DecodeEngine(
+        get_model_config("tiny-test"), params=engine.params, mesh=eight_device_mesh
+    )
+    texts = ["score me please", "and also this longer one here", "x y z"]
+    a = score_texts(engine, texts)
+    b = score_texts(sharded, texts)
+    np.testing.assert_allclose(a.log_likelihoods, b.log_likelihoods, rtol=1e-4)
+
+
 def test_sharded_dp_matches_host_metric(eight_device_mesh):
     """psum-reduced demographic parity == the host-side reference wrapper."""
     rng = np.random.default_rng(0)
