@@ -53,7 +53,9 @@ def parse_ranking_indices(text: str, num_items: int) -> List[int]:
     seen = set()
     ranking: List[int] = []
     for tok in re.split(r"[,\s]+", text.strip()):
-        if not tok.isdigit():
+        # isascii() too: str.isdigit() accepts superscripts/circled digits
+        # ("²", "①") that int() then rejects with ValueError.
+        if not (tok.isascii() and tok.isdigit()):
             continue
         idx = int(tok) - 1
         if 0 <= idx < num_items and idx not in seen:

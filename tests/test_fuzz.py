@@ -1,0 +1,54 @@
+"""Fuzz-ish robustness: parsers and tokenizer must never raise on arbitrary
+model output (real decodes produce arbitrary bytes/unicode; a crash in the
+parse layer would kill a whole sweep chunk)."""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.models.tokenizer import ByteTokenizer
+from fairness_llm_tpu.pipeline.parsing import (
+    canonical_title,
+    parse_comma_list,
+    parse_numbered_list,
+    parse_pairwise_answer,
+    parse_ranking_indices,
+)
+
+
+def _random_texts(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    pool = (
+        "1. ", "2)", "99: ", "A", "B", "tie", ",", "::", "\n", "\t", "  ",
+        "The Matrix (1999)", "Amélie", "movie", "-", "🎬", "\\", '"', "*",
+        "9" * 50, "(", ")", "answer:", "１２３",  # full-width digits
+        "²", "①", "٣",  # isdigit()-true, int()-rejected code points
+    )
+    for _ in range(n):
+        k = rng.integers(0, 12)
+        yield "".join(rng.choice(pool) for _ in range(k))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parsers_never_raise(seed):
+    for text in _random_texts(seed=seed):
+        items = parse_numbered_list(text)
+        assert all(isinstance(t, str) and t for t in items)
+        parse_comma_list(text)
+        ranking = parse_ranking_indices(text, 7)
+        assert sorted(ranking) == list(range(7))  # always a permutation
+        assert parse_pairwise_answer(text) in ("A", "B", "tie")
+        canonical_title(text)
+
+
+def test_tokenizer_roundtrip_arbitrary_unicode():
+    tok = ByteTokenizer(512)
+    for text in ["", "🎬🎥", "ß∂ƒ©", "a\x00b", "The Matrix (1999)\n\n", "é" * 300]:
+        assert tok.decode(tok.encode(text, add_bos=False)) == text
+
+
+def test_tokenizer_decode_garbage_ids():
+    tok = ByteTokenizer(512)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, size=500).tolist()  # includes specials + out-of-byte ids
+    out = tok.decode(ids)  # must not raise; invalid bytes replaced
+    assert isinstance(out, str)
