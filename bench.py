@@ -27,7 +27,6 @@ import sys
 import time
 
 import jax
-import numpy as np
 
 
 REFERENCE_PROFILES_PER_SEC = 45 / (15 * 60)  # README estimate: 45 profiles / ~15 min

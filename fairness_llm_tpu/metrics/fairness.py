@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fairness_llm_tpu.metrics.divergence import js_distance, pairwise_js_matrix
+from fairness_llm_tpu.metrics.divergence import pairwise_js_matrix
 from fairness_llm_tpu.metrics.encode import (
     Vocab,
     count_matrix,

@@ -6,7 +6,7 @@ per-group NDCG over a sharded eval reduces on device.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Set
+from typing import Dict, Sequence, Set
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,7 @@ across the batch — see ``models/transformer.py`` design notes) and ``decode``.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

@@ -17,7 +17,6 @@ is remote OpenAI calls, ``phase1_bias_detection.py:180-188``):
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn

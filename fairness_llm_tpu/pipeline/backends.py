@@ -23,7 +23,7 @@ from typing import List, Optional, Protocol, Sequence
 
 import numpy as np
 
-from fairness_llm_tpu.config import Config, MeshConfig, ModelSettings
+from fairness_llm_tpu.config import Config, ModelSettings
 
 logger = logging.getLogger(__name__)
 

@@ -15,7 +15,7 @@ them, plus:
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 _NUMBERED = re.compile(r"^\s*(\d+)[\.\)\:]\s*(.+?)\s*$")
 _YEAR_SUFFIX = re.compile(r"\s*\((19|20)\d{2}\)\s*$")

@@ -17,7 +17,7 @@ has a single text stream, so the system directive is folded into the prompt.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 from fairness_llm_tpu.data.profiles import Profile
 from fairness_llm_tpu.data.ranking import RankingItem

@@ -15,7 +15,7 @@ seq-sharded).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Sequence
 
 import flax.linen as nn
 import jax
