@@ -64,8 +64,8 @@ def check_setup(config: Config) -> None:
     if not os.path.exists(os.path.join(config.data_dir, "movies.dat")):
         print(f"WARNING: MovieLens not found at {config.data_dir}; synthetic fallback will be used")
     if config.weights_dir is None:
-        print("NOTE: no --weights-dir; model names resolve to randomly initialized weights "
-              "(use --model simulated for the deterministic test backend)")
+        print("NOTE: no --weights-dir; real model names will FAIL (no checkpoint to "
+              "load) — use --model simulated for the no-weights deterministic backend")
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -253,10 +253,16 @@ class Attention(nn.Module):
             # are the entire key set, so the kernel sees only [B, S].
             from fairness_llm_tpu.ops import flash_attention
 
+            # With an int8 cache, later decode steps attend to the quantization
+            # round-trip of these keys/values — attend to the same dequantized
+            # tensors here so flash-eligible and fallback shapes agree.
+            fk, fv = (keys[:, :S], values[:, :S]) if (
+                cfg.kv_cache_quant and cache_layer is not None
+            ) else (k, v)
             out = flash_attention(
                 q.transpose(0, 2, 1, 3),
-                k.astype(dtype).transpose(0, 2, 1, 3),
-                v.astype(dtype).transpose(0, 2, 1, 3),
+                fk.astype(dtype).transpose(0, 2, 1, 3),
+                fv.astype(dtype).transpose(0, 2, 1, 3),
                 jnp.sum(key_valid[:, :S], axis=1, dtype=jnp.int32),
                 causal=True,
                 window=cfg.sliding_window,

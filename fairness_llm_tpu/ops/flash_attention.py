@@ -57,7 +57,7 @@ def _kernel(
     num_k_blocks = pl.cdiv(seq_len, block_k)
     if causal:
         # keys strictly after the last query row of this block are never visible
-        num_k_blocks = jnp.minimum(num_k_blocks, (qi + 1) * block_q // block_k + 1)
+        num_k_blocks = jnp.minimum(num_k_blocks, pl.cdiv((qi + 1) * block_q, block_k))
 
     def body(kb, carry):
         m_acc, l_acc, acc = carry

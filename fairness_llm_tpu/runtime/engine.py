@@ -310,10 +310,15 @@ class DecodeEngine:
         shared_ids: Optional[list] = None
         if share_prefix is not False and n >= 1 and prefix_ids is not None:
             pl = list(prefix_ids)
-            if all(r[: len(pl)] == pl for r in rows):
-                shared_ids = pl
-            else:
+            if not all(r[: len(pl)] == pl for r in rows):
                 logger.warning("prefix_ids is not a prefix of every prompt; sharing disabled")
+            elif not all(len(r) > len(pl) for r in rows):
+                # A row equal to the prefix would decode from an empty
+                # remainder — its first sample would condition on a pad
+                # embedding instead of the last prefix token.
+                logger.warning("a prompt equals the shared prefix; sharing disabled")
+            else:
+                shared_ids = pl
         elif share_prefix is not False and n >= 2 and prefix_ids is None:
             common = _token_lcp(rows)
             min_shared = 64 if share_prefix is None else 1

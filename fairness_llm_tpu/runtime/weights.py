@@ -199,14 +199,20 @@ def load_checkpoint(
         return handles[fname].get_tensor(hf_name)
 
     params: Dict[str, Any] = {}
-    for our_path, (hf_name, transform) in name_map.items():
-        x = transform(get_tensor(hf_name)).astype(dtype)
-        leaf_sharding = None
-        if shardings is not None:
-            leaf_sharding = _tree_get(shardings, our_path)
-        x = jax.device_put(x, leaf_sharding) if leaf_sharding is not None else jnp.asarray(x)
-        _tree_set(params, our_path, x)
-        logger.debug("loaded %s <- %s %s", our_path, hf_name, x.shape)
+    try:
+        for our_path, (hf_name, transform) in name_map.items():
+            x = transform(get_tensor(hf_name)).astype(dtype)
+            leaf_sharding = None
+            if shardings is not None:
+                leaf_sharding = _tree_get(shardings, our_path)
+            x = jax.device_put(x, leaf_sharding) if leaf_sharding is not None else jnp.asarray(x)
+            _tree_set(params, our_path, x)
+            logger.debug("loaded %s <- %s %s", our_path, hf_name, x.shape)
+    finally:
+        # Shard handles hold open fds + mmaps; a multi-shard 70B checkpoint
+        # must not keep them alive until interpreter GC.
+        for h in handles.values():
+            h.__exit__(None, None, None)
     return params
 
 
