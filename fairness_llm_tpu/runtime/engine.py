@@ -310,15 +310,19 @@ class DecodeEngine:
         shared_ids: Optional[list] = None
         if share_prefix is not False and n >= 1 and prefix_ids is not None:
             pl = list(prefix_ids)
-            if not all(r[: len(pl)] == pl for r in rows):
-                logger.warning("prefix_ids is not a prefix of every prompt; sharing disabled")
-            elif not all(len(r) > len(pl) for r in rows):
-                # A row equal to the prefix would decode from an empty
-                # remainder — its first sample would condition on a pad
-                # embedding instead of the last prefix token.
-                logger.warning("a prompt equals the shared prefix; sharing disabled")
-            else:
-                shared_ids = pl
+            # Contract: prefix_ids must be a STRICT prefix of every prompt.
+            # A row equal to the prefix would decode from an empty remainder
+            # (its first sample conditioning on a pad embedding), and quietly
+            # disabling sharing for just this batch would split attention
+            # differently between a sweep chunk and its resume-subset — so a
+            # violation fails loudly instead of diverging numerically.
+            if not all(len(r) > len(pl) and r[: len(pl)] == pl for r in rows):
+                raise ValueError(
+                    "prefix_ids must be a strict prefix of every prompt "
+                    "(recompute it over the full sweep, e.g. via "
+                    "pipeline.backends.shared_prefix_ids)"
+                )
+            shared_ids = pl
         elif share_prefix is not False and n >= 2 and prefix_ids is None:
             common = _token_lcp(rows)
             min_shared = 64 if share_prefix is None else 1

@@ -1,0 +1,226 @@
+"""Logit parity against the ``transformers`` reference implementations.
+
+The study's fidelity rests on ``runtime/weights.py`` + ``models/transformer.py``
+reproducing each family's forward exactly: a transpose, RoPE-convention, or
+QKV-split error would round-trip cleanly through our own save/load tests and
+still decode garbage on real checkpoints. Here the checkpoints are *produced by
+transformers itself* (tiny configs, real architectures, saved to safetensors)
+and our float32 forward must match the torch forward to float32 noise.
+
+Replaces the trust the reference places in the OpenAI API being the model
+(``phase1_bias_detection.py:180-188``): when inference is in-framework the
+framework must prove it computes the same function the published weights mean.
+
+Covers, per family:
+- llama: RoPE rotate-half convention, GQA head grouping, [out,in] transpose
+- llama-tied: tied-embedding lm_head (llama-3.2 style)
+- gemma: sqrt(d_model) embed scale, ``1 + weight`` RMSNorm, tied embeds
+- gpt2: fused-QKV Conv1D split (no transpose), learned positions, gelu_tanh
+- mistral: sliding-window masking at S > window
+plus the cached decode path (greedy parity vs ``generate``), the left-padded
+batch layout, and the ``HFTokenizer`` adapter over a real tokenizer dir.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from fairness_llm_tpu.models.configs import ModelConfig
+from fairness_llm_tpu.models.transformer import Transformer, init_cache
+from fairness_llm_tpu.runtime.weights import load_checkpoint
+
+ATOL = 1e-4  # observed max diff ~2e-7 at f32; wide margin for BLAS variation
+
+_TINY = dict(d=64, ff=128, layers=2, heads=4, vocab=256, seq=256)
+
+
+def _build(family: str):
+    """Tiny real-architecture HF model + the matching framework config."""
+    torch.manual_seed(0)
+    t = _TINY
+    common = dict(
+        name=f"tiny-{family}-parity", vocab_size=t["vocab"], num_layers=t["layers"],
+        num_heads=t["heads"], d_model=t["d"], d_ff=t["ff"], head_dim=16,
+        max_seq_len=t["seq"], rope_theta=10000.0, dtype="float32",
+        use_flash_attention=False,
+    )
+    if family in ("llama", "llama-tied"):
+        tied = family == "llama-tied"
+        hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+            vocab_size=t["vocab"], hidden_size=t["d"], intermediate_size=t["ff"],
+            num_hidden_layers=t["layers"], num_attention_heads=t["heads"],
+            num_key_value_heads=2, head_dim=16, max_position_embeddings=t["seq"],
+            rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=tied,
+            attention_bias=False, mlp_bias=False,
+        ))
+        name = "tiny-llama-parity" if not tied else "tiny-llama-parity-tied"
+        cfg = ModelConfig(**{**common, "name": name}, num_kv_heads=2,
+                          norm_eps=1e-5, tie_embeddings=tied)
+    elif family == "gemma":
+        hf = transformers.GemmaForCausalLM(transformers.GemmaConfig(
+            vocab_size=t["vocab"], hidden_size=t["d"], intermediate_size=t["ff"],
+            num_hidden_layers=t["layers"], num_attention_heads=t["heads"],
+            num_key_value_heads=t["heads"], head_dim=16,
+            max_position_embeddings=t["seq"], rms_norm_eps=1e-6,
+            rope_theta=10000.0, hidden_activation="gelu_pytorch_tanh",
+            attention_bias=False,
+        ))
+        cfg = ModelConfig(**common, num_kv_heads=t["heads"], norm_eps=1e-6,
+                          activation="gelu_tanh", embed_scale=True,
+                          tie_embeddings=True)
+    elif family == "gpt2":
+        hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+            vocab_size=t["vocab"], n_positions=t["seq"], n_embd=t["d"],
+            n_layer=t["layers"], n_head=t["heads"],
+            activation_function="gelu_new", layer_norm_epsilon=1e-5,
+        ))
+        cfg = ModelConfig(**{**common, "d_ff": 4 * t["d"]}, num_kv_heads=t["heads"],
+                          pos_emb="learned", norm="layernorm", mlp="mlp",
+                          use_bias=True, activation="gelu_tanh",
+                          tie_embeddings=True, norm_eps=1e-5)
+    elif family == "mistral":
+        hf = transformers.MistralForCausalLM(transformers.MistralConfig(
+            vocab_size=t["vocab"], hidden_size=t["d"], intermediate_size=t["ff"],
+            num_hidden_layers=t["layers"], num_attention_heads=t["heads"],
+            num_key_value_heads=2, head_dim=16, max_position_embeddings=t["seq"],
+            rms_norm_eps=1e-5, rope_theta=10000.0, sliding_window=8,
+            attn_implementation="eager",
+        ))
+        cfg = ModelConfig(**common, num_kv_heads=2, norm_eps=1e-5,
+                          sliding_window=8)
+    else:
+        raise KeyError(family)
+    return hf.eval(), cfg
+
+
+def _load(hf, cfg, path):
+    hf.save_pretrained(str(path), safe_serialization=True)
+    return load_checkpoint(cfg, str(path), dtype=np.float32)
+
+
+FAMILIES = ["llama", "llama-tied", "gemma", "gpt2", "mistral"]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_logit_parity(family, tmp_path):
+    hf, cfg = _build(family)
+    params = _load(hf, cfg, tmp_path)
+    # S=16 exceeds mistral's window of 8, so sliding-window masking is live.
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 16))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens)).logits.numpy()
+    positions = np.tile(np.arange(16, dtype=np.int32)[None, :], (2, 1))
+    ours, _ = Transformer(cfg).apply(
+        {"params": params}, tokens.astype(np.int32), positions
+    )
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=ATOL)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_greedy_decode_parity(family, tmp_path):
+    """Prefill + cached single-token decode must follow the same greedy path
+    transformers' ``generate`` takes — exercises the KV-cache write/read,
+    position bookkeeping, and last-position logits end to end."""
+    hf, cfg = _build(family)
+    params = _load(hf, cfg, tmp_path)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, size=(1, 7))
+    new = 8
+
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor(prompt), max_new_tokens=new, do_sample=False,
+            pad_token_id=0,
+        ).numpy()[0, prompt.shape[1]:]
+
+    model = Transformer(cfg)
+    cache = init_cache(cfg, 1, prompt.shape[1] + new)
+    positions = np.arange(prompt.shape[1], dtype=np.int32)[None, :]
+    logits, cache = model.apply(
+        {"params": params}, prompt.astype(np.int32), positions,
+        np.ones(prompt.shape, bool), cache, last_only=True,
+    )
+    got = []
+    for _ in range(new):
+        tok = int(np.argmax(np.asarray(logits)[0, -1]))
+        got.append(tok)
+        pos = np.asarray(cache.lengths, np.int32)[None, :]
+        logits, cache = model.apply(
+            {"params": params}, np.asarray([[tok]], np.int32), pos,
+            np.ones((1, 1), bool), cache,
+        )
+    np.testing.assert_array_equal(np.asarray(got), theirs)
+
+
+def test_left_padded_batch_parity(tmp_path):
+    """Rows of different lengths, left-padded into one batch, must produce the
+    same last-position logits as per-row unpadded HF forwards — validates the
+    pad masking + position clamping the decode engine relies on."""
+    hf, cfg = _build("llama")
+    params = _load(hf, cfg, tmp_path)
+    rng = np.random.default_rng(2)
+    rows = [rng.integers(0, cfg.vocab_size, size=(n,)) for n in (5, 9)]
+
+    theirs = []
+    for row in rows:
+        with torch.no_grad():
+            theirs.append(hf(torch.tensor(row[None, :])).logits.numpy()[0, -1])
+
+    S = 9
+    tokens = np.zeros((2, S), np.int32)
+    valid = np.zeros((2, S), bool)
+    for i, row in enumerate(rows):
+        tokens[i, S - len(row):] = row
+        valid[i, S - len(row):] = True
+    positions = np.maximum(np.cumsum(valid, axis=1) - 1, 0).astype(np.int32)
+    ours, _ = Transformer(cfg).apply(
+        {"params": params}, tokens, positions, valid, last_only=True
+    )
+    ours = np.asarray(ours)[:, -1, :]
+    np.testing.assert_allclose(ours[0], theirs[0], atol=ATOL)
+    np.testing.assert_allclose(ours[1], theirs[1], atol=ATOL)
+
+
+def test_hf_tokenizer_adapter(tmp_path):
+    """HFTokenizer over a real on-disk tokenizer dir (built with the
+    ``tokenizers`` library — no network) must agree with the transformers
+    tokenizer it wraps and satisfy the engine's pad/eos contract."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import decoders
+    from tokenizers import models as tok_models
+    from tokenizers import pre_tokenizers, trainers
+
+    corpus = [
+        "Recommend 10 movies for a 25-34 year old user.",
+        "The user has watched: The Matrix (1999), Toy Story (1995).",
+        "Please respond with a numbered list of movie titles.",
+    ] * 8
+    tok = tokenizers.Tokenizer(tok_models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.train_from_iterator(
+        corpus,
+        trainers.BpeTrainer(vocab_size=400, special_tokens=["<|endoftext|>"]),
+    )
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, eos_token="<|endoftext|>"
+    )
+    fast.save_pretrained(str(tmp_path))
+
+    from fairness_llm_tpu.models.tokenizer import HFTokenizer
+
+    ours = HFTokenizer(str(tmp_path))
+    text = "Recommend 10 movies for a user."
+    assert ours.encode(text) == fast.encode(text)
+    assert ours.decode(ours.encode(text)) == text
+    # no pad token declared -> engine's pad falls back to eos
+    assert ours.pad_id == fast.eos_token_id
+    assert ours.eos_id == fast.eos_token_id
+
+    batch = ours.encode_batch(["short", "a much longer prompt here"])
+    assert batch.tokens.shape[0] == 2
+    assert bool(batch.valid[0, 0]) is False  # left-padded
+    assert bool(batch.valid[0, -1]) is True
