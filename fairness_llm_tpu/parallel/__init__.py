@@ -14,6 +14,8 @@ from fairness_llm_tpu.parallel.sharding import (
     param_shardings,
     shard_params,
     batch_sharding,
+    per_device_param_bytes,
+    per_device_kv_cache_bytes,
 )
 
 __all__ = [
@@ -22,4 +24,6 @@ __all__ = [
     "param_shardings",
     "shard_params",
     "batch_sharding",
+    "per_device_param_bytes",
+    "per_device_kv_cache_bytes",
 ]

@@ -22,6 +22,7 @@ The reference has no equivalent — its "distributed backend" is HTTPS to OpenAI
 
 from __future__ import annotations
 
+import functools
 from typing import Any, List, Optional, Tuple
 
 import flax.linen as nn
@@ -53,7 +54,15 @@ def make_mesh(mesh_config: MeshConfig, devices: Optional[List] = None) -> Mesh:
 
 
 def make_axis_rules(model_config: ModelConfig, mesh: Mesh) -> AxisRules:
-    """Logical->mesh axis rules, dropping mappings that don't divide evenly."""
+    """Logical->mesh axis rules, dropping mappings that don't divide evenly.
+
+    Head projections shard at HEAD granularity (num_heads % tp), not just dim
+    granularity: a dim-divisible split that bisects heads would force GSPMD to
+    re-gather around every attention einsum. GQA models with fewer KV heads
+    than tp fall back to replicated KV (llama3-70b kv_heads=8 shards exactly
+    1 head/chip at tp=8 but replicates at tp=16) — the same fallback
+    production TP serving uses.
+    """
     tp = mesh.shape.get("tp", 1)
     sp = mesh.shape.get("sp", 1)
 
@@ -64,12 +73,29 @@ def make_axis_rules(model_config: ModelConfig, mesh: Mesh) -> AxisRules:
         ("batch", "dp"),
         ("seq", "sp" if sp > 1 else None),
         ("embed", None),
-        ("q_heads", "tp" if fits(model_config.q_dim) else None),
-        ("kv_heads", "tp" if fits(model_config.kv_dim) else None),
+        ("q_heads", "tp" if fits(model_config.num_heads) else None),
+        ("kv_heads", "tp" if fits(model_config.num_kv_heads) else None),
         ("ff", "tp" if fits(model_config.d_ff) else None),
         ("vocab", "tp" if fits(model_config.vocab_size) else None),
     ]
     return tuple(rules)
+
+
+@functools.lru_cache(maxsize=8)
+def _abstract_params(model_config: ModelConfig):
+    """(partition specs, abstract shapes) from one metadata-only init trace.
+
+    Cached: an 80-layer abstract trace costs seconds, and engine construction
+    needs it for both shardings and the byte estimate.
+    """
+    from fairness_llm_tpu.models.transformer import Transformer
+
+    model = Transformer(model_config)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.key(0), tokens, tokens)
+    specs = nn.get_partition_spec(abstract)["params"]
+    shapes = nn.meta.unbox(abstract["params"])
+    return specs, shapes
 
 
 def param_shardings(model_config: ModelConfig, mesh: Mesh, rules: Optional[AxisRules] = None) -> Any:
@@ -78,15 +104,9 @@ def param_shardings(model_config: ModelConfig, mesh: Mesh, rules: Optional[AxisR
     Uses ``jax.eval_shape`` over ``model.init`` (no FLOPs, no memory) to recover
     the logical partitioning metadata, then maps it through the axis rules.
     """
-    from fairness_llm_tpu.models.transformer import Transformer
-
     if rules is None:
         rules = make_axis_rules(model_config, mesh)
-    model = Transformer(model_config)
-    tokens = jnp.zeros((1, 8), jnp.int32)
-    positions = jnp.zeros((1, 8), jnp.int32)
-    abstract = jax.eval_shape(model.init, jax.random.key(0), tokens, positions)
-    specs = nn.get_partition_spec(abstract)["params"]
+    specs, _ = _abstract_params(model_config)
     return jax.tree.map(
         lambda spec: NamedSharding(mesh, _resolve_spec(spec, rules)),
         specs,
@@ -107,3 +127,59 @@ def shard_params(params: Any, shardings: Any) -> Any:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [B, ...] token batches: batch over dp, rest replicated."""
     return NamedSharding(mesh, P("dp"))
+
+
+def per_device_param_bytes(model_config: ModelConfig, mesh: Mesh,
+                           rules: Optional[AxisRules] = None,
+                           itemsize: Optional[int] = None) -> int:
+    """Analytic per-device parameter bytes under the sharding rules.
+
+    Walks the same eval_shape partition specs ``param_shardings`` uses; each
+    leaf contributes size/prod(mapped mesh axes). This is what lets the CLI
+    flag a config that cannot fit before any weight streams off disk — e.g.
+    llama3-70b bf16 at tp=8 is ~17.6 GB/chip, OVER a v5e's 16 GB HBM (the fit
+    paths are tp=16 across two v5e-8 slices, or int8 weights).
+
+    ``itemsize`` overrides the config-dtype byte width — the engine stores
+    small bf16-config models in float32 (see DecodeEngine param policy) and
+    passes its actual storage width.
+    """
+    if rules is None:
+        rules = make_axis_rules(model_config, mesh)
+    specs, shapes = _abstract_params(model_config)
+    if itemsize is None:
+        itemsize = 2 if model_config.dtype == "bfloat16" else 4
+
+    total = 0
+    flat_specs = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    flat_shapes = jax.tree.leaves(shapes)
+    for spec, leaf in zip(flat_specs, flat_shapes):
+        resolved = _resolve_spec(spec, rules)
+        div = 1
+        for axis in resolved:
+            if axis is not None:
+                div *= mesh.shape.get(axis, 1)
+        total += int(np.prod(leaf.shape)) * itemsize // div
+    return total
+
+
+def per_device_kv_cache_bytes(model_config: ModelConfig, mesh: Mesh, batch: int,
+                              max_len: int, rules: Optional[AxisRules] = None) -> int:
+    """Per-device KV-cache bytes for a decode of ``batch`` rows x ``max_len``
+    slots: [B, L, Hkv, D] x 2 (k and v) x num_layers, batch split over dp and
+    kv heads over tp when the rules shard them (int8 quant halves it but adds
+    the f32 scales)."""
+    if rules is None:
+        rules = make_axis_rules(model_config, mesh)
+    kv_axis = dict(rules).get("kv_heads")
+    kv_div = mesh.shape.get(kv_axis, 1) if kv_axis else 1
+    dp = mesh.shape.get("dp", 1)
+    # ceil, matching the engine's batch padding to a dp multiple — floor would
+    # undercount (batch 12 on dp=8 decodes 2 rows/device, not 1).
+    rows_per_device = -(-batch // dp)
+    slots = rows_per_device * max_len * (model_config.num_kv_heads // kv_div)
+    if model_config.kv_cache_quant:
+        per_slot = model_config.head_dim * 1 + 4  # int8 values + f32 scale
+    else:
+        per_slot = model_config.head_dim * (2 if model_config.dtype == "bfloat16" else 4)
+    return 2 * model_config.num_layers * slots * per_slot

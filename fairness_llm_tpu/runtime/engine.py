@@ -115,6 +115,23 @@ class DecodeEngine:
         param_dtype = (
             jnp.bfloat16 if (model_config.dtype == "bfloat16" and big) else jnp.float32
         )
+        if self.mesh is not None:
+            pb = shd.per_device_param_bytes(
+                model_config, self.mesh, self.rules,
+                itemsize=2 if param_dtype == jnp.bfloat16 else 4,
+            )
+            logger.info(
+                "%s on mesh %s: ~%.2f GB params per device",
+                model_config.name, dict(self.mesh.shape), pb / 1e9,
+            )
+            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+            limit = stats.get("bytes_limit")
+            if limit and pb > 0.95 * limit:
+                logger.warning(
+                    "per-device params (%.1f GB) likely exceed the chip's %.1f GB "
+                    "HBM — use a larger tp axis or quantized weights",
+                    pb / 1e9, limit / 1e9,
+                )
         if params is None:
             logger.info("initializing random params for %s", model_config.name)
             # Low-memory init: allocates each leaf directly in the target
