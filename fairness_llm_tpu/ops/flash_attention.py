@@ -16,8 +16,9 @@ Layout contract:
 - causal masking over slot indices (left-padding keeps causality aligned)
 - optional sliding window (Mistral): key j visible iff q_idx - j < window
 
-Supported when D and S are multiples of the 128-lane tile; callers fall back
-to the XLA path otherwise (``flash_supported``).
+Supported when S is a multiple of the 128-lane tile and D is a multiple of
+64 (a 64-lane D tail pads to the 128-lane tile at half occupancy — see
+``flash_supported``); callers fall back to the XLA path otherwise.
 """
 
 from __future__ import annotations
@@ -94,11 +95,16 @@ def _kernel(
 
 
 def flash_supported(seq_len: int, head_dim: int, block_q: int = 128, block_k: int = 128) -> bool:
+    """head_dim >= 64: a 64-lane tail pads to the 128-lane tile (half-lane
+    occupancy on the D axis) but the kernel stays correct and still beats the
+    XLA dense path — prefill is score-matmul-bound, and the [bq, bk] score
+    tiles are full 128x128 regardless of D. head_dim < 64 wastes > half the
+    VMEM tile; fall back to XLA there."""
     return (
         seq_len % block_k == 0
         and seq_len >= block_q
         and seq_len % block_q == 0
-        and head_dim % 128 == 0
+        and head_dim % 64 == 0
     )
 
 

@@ -49,6 +49,8 @@ class GenerateOutput:
     tokens: np.ndarray  # [B, max_new] int32 (pad-filled after EOS)
     steps: int  # decode-step CAP (max_new_tokens); actual trip count is
     # dynamic — the while_loop exits once every real row hits EOS
+    stats: Optional[Dict[str, int]] = None  # decode-shape diagnostics
+    # (batch, prompt_len, prefix_len, cache_slots) for byte accounting
 
 
 def _bucket_len(n: int, multiple: int = 64) -> int:
@@ -93,10 +95,12 @@ class DecodeEngine:
         tokenizer_path: Optional[str] = None,
         seed: int = 0,
         assume_sharded: bool = False,
+        param_dtype: Optional[str] = None,
     ):
         """``assume_sharded=True`` skips re-placing params onto the mesh —
         for callers (weights loader) that already device_put each tensor onto
-        its NamedSharding at load time."""
+        its NamedSharding at load time. ``param_dtype`` ("float32"/"bfloat16")
+        overrides the size-based storage policy."""
         self.config = model_config
         self.tokenizer = tokenizer or tokenizer_for(model_config, tokenizer_path)
         self.mesh = mesh
@@ -112,9 +116,19 @@ class DecodeEngine:
         # bytes/param of HBM the cache needs — so large bf16 models store
         # params in bf16.
         big = model_config.approx_param_count >= 1_000_000_000
-        param_dtype = (
-            jnp.bfloat16 if (model_config.dtype == "bfloat16" and big) else jnp.float32
-        )
+        if param_dtype is not None:
+            if param_dtype not in ("float32", "bfloat16"):
+                raise ValueError(
+                    f"param_dtype must be 'float32' or 'bfloat16', got {param_dtype!r}"
+                )
+            param_dtype = jnp.bfloat16 if param_dtype == "bfloat16" else jnp.float32
+        else:
+            param_dtype = (
+                jnp.bfloat16 if (model_config.dtype == "bfloat16" and big) else jnp.float32
+            )
+        # The resolved storage width, for byte-accounting callers (bench.py's
+        # roofline model must not re-derive this policy and drift).
+        self.param_itemsize = 2 if param_dtype == jnp.bfloat16 else 4
         if self.mesh is not None:
             pb = shd.per_device_param_bytes(
                 model_config, self.mesh, self.rules,
@@ -161,7 +175,7 @@ class DecodeEngine:
         padding. Shared by decode prefill and scoring so both stay eligible."""
         flash_eligible = (
             self.config.use_flash_attention
-            and self.config.head_dim % 128 == 0
+            and self.config.head_dim % 64 == 0
             and jax.default_backend() == "tpu"
         )
         return 128 if flash_eligible else 64
@@ -367,7 +381,10 @@ class DecodeEngine:
             remainders = [r[len(shared_ids):] for r in rows]
             rem_budget = prompt_budget - len(shared_ids)
             tb = _left_pad(remainders, self.tokenizer.pad_id)
-            prompt_len = _bucket_len(min(tb.tokens.shape[1], rem_budget), 64)
+            # Remainder rows are short (the sweep's prompts differ only past
+            # the prefix); a 32-multiple bucket keeps 32 fewer KV slots per
+            # row streaming through every decode step than the default 64.
+            prompt_len = _bucket_len(min(tb.tokens.shape[1], rem_budget), 32)
             if prompt_len > rem_budget:
                 prompt_len = max(rem_budget, 1)
             if tb.tokens.shape[1] > prompt_len:
@@ -457,4 +474,10 @@ class DecodeEngine:
                     break
                 ids.append(int(t))
             texts.append(self.tokenizer.decode(ids))
-        return GenerateOutput(texts=texts, tokens=out, steps=max_new)
+        stats = {
+            "batch": batch,
+            "prompt_len": prompt_len,
+            "prefix_len": prefix_len,
+            "cache_slots": prompt_len + max_new,
+        }
+        return GenerateOutput(texts=texts, tokens=out, steps=max_new, stats=stats)

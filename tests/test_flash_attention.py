@@ -27,11 +27,17 @@ def _dense(q, k, v, lengths, causal=True, window=None):
 
 
 @pytest.mark.parametrize(
-    "kwargs",
-    [dict(causal=True), dict(causal=False), dict(causal=True, window=64)],
+    "kwargs,D",
+    [
+        (dict(causal=True), 128),
+        (dict(causal=False), 128),
+        (dict(causal=True, window=64), 128),
+        (dict(causal=True), 64),  # gpt2/llama32-1b head_dim (padded lanes)
+        (dict(causal=False), 64),
+    ],
 )
-def test_flash_matches_dense_interpret(kwargs):
-    B, H, Hkv, S, D = 2, 4, 2, 256, 128
+def test_flash_matches_dense_interpret(kwargs, D):
+    B, H, Hkv, S = 2, 4, 2, 256
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32))
     k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)).astype(np.float32))
@@ -47,5 +53,6 @@ def test_flash_matches_dense_interpret(kwargs):
 
 def test_flash_supported_gates():
     assert flash_supported(256, 128)
-    assert not flash_supported(256, 64)  # gpt2 head_dim
+    assert flash_supported(256, 64)  # gpt2/llama32-1b head_dim: padded lanes
+    assert not flash_supported(256, 32)  # sub-64 wastes > half the tile
     assert not flash_supported(200, 128)  # non-multiple seq
