@@ -1,0 +1,50 @@
+"""data/download.py: extract+verify logic against a fabricated local archive
+(no network — the fetch path is exercised via a file:// URL)."""
+
+import os
+import zipfile
+
+from fairness_llm_tpu.data.download import EXPECTED_ROWS, fetch_ml1m
+
+
+def _make_zip(path, rows_per_table):
+    with zipfile.ZipFile(path, "w") as z:
+        for table, rows in rows_per_table.items():
+            z.writestr(f"ml-1m/{table}", "x::y::z\n" * rows)
+
+
+def test_fetch_extracts_and_verifies(tmp_path):
+    archive = tmp_path / "ml-1m.zip"
+    _make_zip(archive, EXPECTED_ROWS)
+    data_dir = tmp_path / "data"
+    assert fetch_ml1m(str(data_dir), url=f"file://{archive}")
+    for table in EXPECTED_ROWS:
+        assert (data_dir / table).exists()
+
+
+def test_fetch_skips_when_present(tmp_path):
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    for table in EXPECTED_ROWS:
+        (data_dir / table).write_text("1::2::3\n")
+    # unreachable URL never touched: tables already present
+    assert fetch_ml1m(str(data_dir), url="file:///nonexistent.zip")
+
+
+def test_fetch_fails_gracefully_offline(tmp_path, capsys):
+    data_dir = tmp_path / "data"
+    assert not fetch_ml1m(str(data_dir), url=f"file://{tmp_path}/missing.zip")
+    assert "manually" in capsys.readouterr().err
+
+
+def test_fetch_rejects_wrong_row_counts(tmp_path):
+    archive = tmp_path / "bad.zip"
+    _make_zip(archive, {t: 5 for t in EXPECTED_ROWS})
+    assert not fetch_ml1m(str(tmp_path / "data"), url=f"file://{archive}")
+
+
+def test_fetch_rejects_non_zip_payload(tmp_path, capsys):
+    payload = tmp_path / "portal.zip"
+    payload.write_text("<html>sign in to continue</html>")
+    assert not fetch_ml1m(str(tmp_path / "data"), url=f"file://{payload}")
+    assert "manually" in capsys.readouterr().err
