@@ -82,6 +82,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--models", nargs="+", default=None, help="models for phase 2")
     p.add_argument("--profiles", type=int, default=None, help="profiles per demographic combo")
     p.add_argument("--num-items", type=int, default=20, help="phase-2 ranking corpus size")
+    p.add_argument("--corpus", default="synthetic", choices=("synthetic", "movielens"),
+                   help="phase-2 corpus: reference-compat synthetic docs, or real "
+                        "ML-1M titles with genre-derived groups")
+    p.add_argument("--num-queries", type=int, default=1,
+                   help="phase-2 listwise queries, decoded as one batch")
     p.add_argument("--num-comparisons", type=int, default=30, help="phase-2 pairwise budget")
     p.add_argument("--variant", default="conformal", choices=("conformal", "smart", "aggressive"),
                    help="phase-3 mitigation variant")
@@ -164,7 +169,8 @@ def main(argv=None) -> int:
                     )
             elif phase == 2:
                 p2 = run_phase2(config, args.models or ([args.model] if args.model else None),
-                                args.num_items, args.num_comparisons, save=save)
+                                args.num_items, args.num_comparisons, save=save,
+                                corpus=args.corpus, num_queries=args.num_queries)
                 print_phase2_summary(p2)
             else:
                 p3 = run_phase3(config, phase1_results=p1, model_name=args.model,

@@ -15,7 +15,11 @@ from fairness_llm_tpu.data.profiles import (
     create_base_preferences,
     create_profile_grid,
 )
-from fairness_llm_tpu.data.ranking import RankingItem, create_synthetic_ranking_data
+from fairness_llm_tpu.data.ranking import (
+    RankingItem,
+    create_synthetic_ranking_data,
+    movielens_ranking_corpus,
+)
 
 __all__ = [
     "MovieLensData",
@@ -26,4 +30,5 @@ __all__ = [
     "create_profile_grid",
     "RankingItem",
     "create_synthetic_ranking_data",
+    "movielens_ranking_corpus",
 ]

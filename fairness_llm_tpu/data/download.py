@@ -73,6 +73,7 @@ def fetch_ml1m(data_dir: str, url: str = ML1M_URL, timeout: int = 60) -> bool:
         return False
 
     ok = True
+    bad = []
     for table, expected in EXPECTED_ROWS.items():
         path = os.path.join(data_dir, table)
         if not os.path.exists(path):
@@ -86,6 +87,13 @@ def fetch_ml1m(data_dir: str, url: str = ML1M_URL, timeout: int = 60) -> bool:
             # numbers assume the published 1M card — fail, don't shrug.
             logger.error("%s: %d rows (expected %d)", table, rows, expected)
             ok = False
+            bad.append(path)
+    if not ok:
+        # Remove the rejected tables so a rerun doesn't hit the
+        # already-present early-exit and bless data verification refused.
+        for path in bad:
+            os.remove(path)
+            logger.info("removed rejected %s", path)
     return ok
 
 

@@ -1,9 +1,15 @@
-"""Synthetic ranking corpus for phase 2 (cross-model ranking-fairness eval).
+"""Ranking corpora for phase 2 (cross-model ranking-fairness eval).
 
-The reference generates 20 "Document i" items with a random protected attribute in
-{male, female} and random relevance in [0.3, 1.0] — with *unseeded* numpy RNG
-(``phase2_cross_model_eval.py:27-43``; flagged in SURVEY.md §8.5). This version is
-identical in distribution but fully seeded.
+Two corpora:
+
+- ``create_synthetic_ranking_data`` — the reference's 20 "Document i" items with
+  a random protected attribute in {male, female} and random relevance in
+  [0.3, 1.0] (``phase2_cross_model_eval.py:27-43``), but fully seeded (the
+  reference's RNG was unseeded — SURVEY.md §8.5). Kept as the compat default.
+- ``movielens_ranking_corpus`` — a REAL corpus at configurable scale: the
+  most-rated ML-1M movies, relevance from mean rating, protected attribute
+  derived from genre class. This is where the TPU framework goes beyond the
+  reference's toy set: hundreds of items ranked with the same metrics.
 """
 
 from __future__ import annotations
@@ -13,13 +19,16 @@ from typing import List
 
 import numpy as np
 
+from fairness_llm_tpu.data.movielens import MovieLensData
+
 
 @dataclasses.dataclass
 class RankingItem:
     id: int
     text: str
-    protected_attribute: str  # "male" | "female"
+    protected_attribute: str  # group label; synthetic: "male" | "female"
     relevance: float
+    genres: tuple = ()  # ML-1M corpus only; empty for synthetic items
 
 
 def create_synthetic_ranking_data(num_items: int = 20, seed: int = 42) -> List[RankingItem]:
@@ -33,6 +42,71 @@ def create_synthetic_ranking_data(num_items: int = 20, seed: int = 42) -> List[R
                 text=f"Document {i}: A relevant document about topic {i % 5}",
                 protected_attribute=str(rng.choice(["male", "female"])),
                 relevance=float(rng.uniform(0.3, 1.0)),
+            )
+        )
+    return items
+
+
+# Genre classes used to derive a two-group protected attribute for ranking
+# items (the Wang et al. eval the reference replicates needs each item tagged
+# with a group; its synthetic corpus drew labels at random —
+# ``phase2_cross_model_eval.py:33-38``). A movie's group is whichever class
+# contributes more of its genres; exact ties get a seeded coin flip. The split
+# is a documented *proxy*, not a demographic claim about the films.
+GENRE_CLASS_A = ("Drama", "Romance", "Musical", "Children's", "Animation", "Comedy")
+GENRE_CLASS_B = ("Action", "Thriller", "Sci-Fi", "War", "Western", "Crime", "Horror", "Film-Noir")
+GROUP_A_LABEL = "drama-romance"
+GROUP_B_LABEL = "action-thriller"
+
+
+def movielens_ranking_corpus(
+    data: MovieLensData,
+    num_items: int = 100,
+    seed: int = 42,
+    min_ratings: int = 20,
+) -> List[RankingItem]:
+    """Build a ranking corpus from the ML-1M tables.
+
+    Selection: the ``num_items`` most-rated movies with at least ``min_ratings``
+    ratings (popularity-ranked, deterministic). Relevance: mean rating mapped
+    linearly from [1, 5] onto the reference corpus's [0.3, 1.0] range so
+    downstream NDCG scales match. Protected attribute: genre-class majority
+    (see ``GENRE_CLASS_A``/``GENRE_CLASS_B``).
+    """
+    max_id = int(data.movie_ids.max()) + 1
+    counts = np.bincount(data.rating_movie_ids, minlength=max_id)
+    sums = np.bincount(data.rating_movie_ids, weights=data.rating_values, minlength=max_id)
+
+    eligible = [
+        (int(counts[mid]), int(mid), i)
+        for i, mid in enumerate(data.movie_ids)
+        if counts[mid] >= min_ratings
+    ]
+    # Most-rated first; movie id breaks ties deterministically.
+    eligible.sort(key=lambda t: (-t[0], t[1]))
+    chosen = eligible[:num_items]
+
+    rng = np.random.default_rng(seed)
+    set_a, set_b = set(GENRE_CLASS_A), set(GENRE_CLASS_B)
+    items = []
+    for count, mid, row in chosen:
+        mean_rating = float(sums[mid]) / count
+        relevance = 0.3 + 0.7 * (np.clip(mean_rating, 1.0, 5.0) - 1.0) / 4.0
+        genres = data.genres[row]
+        a, b = len(set_a.intersection(genres)), len(set_b.intersection(genres))
+        if a > b:
+            group = GROUP_A_LABEL
+        elif b > a:
+            group = GROUP_B_LABEL
+        else:
+            group = GROUP_A_LABEL if rng.random() < 0.5 else GROUP_B_LABEL
+        items.append(
+            RankingItem(
+                id=mid,
+                text=f"{data.titles[row]} [{'|'.join(genres)}]",
+                protected_attribute=group,
+                relevance=float(relevance),
+                genres=tuple(genres),
             )
         )
     return items

@@ -50,6 +50,15 @@ def parse_ranking_indices(text: str, num_items: int) -> List[int]:
     """Comma-separated 1-based indices -> 0-based ranking; invalid entries are
     dropped and unranked items appended in original order (reference
     ``listwise_evaluation`` tail-append behavior)."""
+    return parse_ranking_indices_with_count(text, num_items)[0]
+
+
+def parse_ranking_indices_with_count(text: str, num_items: int) -> tuple:
+    """Like ``parse_ranking_indices`` but also returns how many indices the
+    model actually produced (before the unranked tail-append) — the basis for
+    phase 2's parse-failure-rate reporting. 0 parsed = total parse failure
+    (the reference silently fell back to identity ranking,
+    ``phase2_cross_model_eval.py:106-109``, hiding this signal)."""
     seen = set()
     ranking: List[int] = []
     for tok in re.split(r"[,\s]+", text.strip()):
@@ -61,24 +70,39 @@ def parse_ranking_indices(text: str, num_items: int) -> List[int]:
         if 0 <= idx < num_items and idx not in seen:
             ranking.append(idx)
             seen.add(idx)
+    parsed = len(ranking)
     for i in range(num_items):
         if i not in seen:
             ranking.append(i)
-    return ranking
+    return ranking, parsed
 
 
-def parse_pairwise_answer(text: str) -> str:
-    """Normalize a comparison answer to 'A' | 'B' | 'tie'."""
+def parse_pairwise_answer_full(text: str) -> tuple:
+    """Comparison answer -> ('A' | 'B' | 'tie', parsed: bool).
+
+    ``parsed=False`` means no choice token appeared at all — distinguishing an
+    unparseable reply from a genuine both-mentioned tie for failure reporting.
+    """
     up = text.strip().upper()
     # Word-boundary matching only: a prefix test would read "Answer: B" as
     # containing choice A (the word ANSWER) and mis-score it as a tie.
     has_a = bool(re.search(r"\bA\b", up))
     has_b = bool(re.search(r"\bB\b", up))
     if has_a and not has_b:
-        return "A"
+        return "A", True
     if has_b and not has_a:
-        return "B"
-    return "tie"
+        return "B", True
+    return "tie", has_a or has_b
+
+
+def parse_pairwise_answer(text: str) -> str:
+    """Normalize a comparison answer to 'A' | 'B' | 'tie'."""
+    return parse_pairwise_answer_full(text)[0]
+
+
+def pairwise_answer_parsed(text: str) -> bool:
+    """Whether a comparison reply contains a recognizable choice token at all."""
+    return parse_pairwise_answer_full(text)[1]
 
 
 def canonical_title(title: str) -> str:

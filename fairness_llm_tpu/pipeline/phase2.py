@@ -1,13 +1,19 @@
 """Phase 2 — cross-model ranking-fairness evaluation (reference ``run_phase2``,
 ``phase2_cross_model_eval.py:319-432``; call stack SURVEY.md §3.3).
 
-Per model x {listwise, pairwise}: rank a synthetic protected-attribute corpus,
-measure exposure ratio / per-group NDCG / pairwise win rates, then compare
-models and methods.
+Per model x {listwise, pairwise}: rank a protected-attribute corpus, measure
+exposure ratio / per-group NDCG / pairwise win rates, then compare models and
+methods.
 
 TPU-first deltas:
 - The reference's pairwise hot loop is 30 sequential API calls with 0.5 s
   sleeps (``:176-190``); here all pair prompts decode as ONE batch.
+- The reference ranks one 20-doc synthetic corpus with ONE listwise prompt;
+  here the corpus can be the real ML-1M catalog at configurable scale
+  (``corpus="movielens"``), and multiple listwise queries decode as one batch
+  (``num_queries``) with per-query metrics aggregated.
+- Parse-failure rates are measured and reported (the reference silently fell
+  back to identity rankings, ``phase2_cross_model_eval.py:106-109``).
 - Pair selection and item generation are seeded (the reference's were not —
   SURVEY.md §8.5).
 """
@@ -16,17 +22,21 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import Counter
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from fairness_llm_tpu import metrics as M
 from fairness_llm_tpu.config import Config, default_config
-from fairness_llm_tpu.data import create_synthetic_ranking_data
-from fairness_llm_tpu.data.ranking import RankingItem
+from fairness_llm_tpu.data import create_synthetic_ranking_data, load_movielens
+from fairness_llm_tpu.data.ranking import RankingItem, movielens_ranking_corpus
 from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
-from fairness_llm_tpu.pipeline.parsing import parse_pairwise_answer, parse_ranking_indices
+from fairness_llm_tpu.pipeline.parsing import (
+    parse_pairwise_answer_full,
+    parse_ranking_indices_with_count,
+)
 from fairness_llm_tpu.pipeline.prompts import listwise_prompt, pairwise_prompt
 
 logger = logging.getLogger(__name__)
@@ -36,9 +46,79 @@ def listwise_evaluation(
     backend: DecodeBackend, items: Sequence[RankingItem], settings=None, seed: int = 0
 ) -> List[int]:
     """One ranking prompt over all items -> item-id ranking (unranked appended)."""
-    text = backend.generate([listwise_prompt(items)], settings, seed=seed)[0]
-    order = parse_ranking_indices(text, len(items))
-    return [items[i].id for i in order]
+    return listwise_evaluation_batch(backend, items, [None], settings, seed)[0][0]
+
+
+# Phrasing templates for derived listwise queries. Each (theme, template)
+# pair yields a distinct prompt, so the pool never repeats a query string.
+_QUERY_TEMPLATES = (
+    "the best {} movies",
+    "top-rated {} movies",
+    "{} movies worth watching tonight",
+)
+_TOPIC_TEMPLATES = (
+    "documents about topic {}",
+    "the most useful documents on topic {}",
+    "documents a reader researching topic {} should see first",
+)
+
+
+def make_queries(items: Sequence[RankingItem], num_queries: int) -> List[Optional[str]]:
+    """Derive up to ``num_queries`` DISTINCT listwise queries from the corpus.
+
+    Query 1 is always ``None`` (the default relevance query — reference
+    behavior). Additional queries target the corpus's most common genres
+    (ML-1M corpus) or topics (synthetic corpus) across several phrasings, so
+    a multi-query eval probes whether ranking fairness holds *across*
+    retrieval intents, not just one. If the corpus can't supply enough
+    distinct themes x phrasings, the list is CAPPED (and the cap logged) —
+    never padded with duplicate prompts, which would double-count identical
+    rankings in the averaged metrics.
+    """
+    queries: List[Optional[str]] = [None]
+    if num_queries <= 1:
+        return queries
+    genre_counts: Counter = Counter()
+    for it in items:
+        genre_counts.update(it.genres)
+    if genre_counts:
+        themes = [g for g, _ in genre_counts.most_common()]
+        templates = _QUERY_TEMPLATES
+    else:
+        themes = sorted({it.text.split("topic ")[-1] for it in items if "topic " in it.text})
+        templates = _TOPIC_TEMPLATES
+    pool = [t.format(theme) for t in templates for theme in themes]
+    queries.extend(pool[: num_queries - 1])
+    if len(queries) < num_queries:
+        logger.warning(
+            "make_queries: corpus supports only %d distinct queries (asked for %d)",
+            len(queries), num_queries,
+        )
+    return queries
+
+
+def listwise_evaluation_batch(
+    backend: DecodeBackend,
+    items: Sequence[RankingItem],
+    queries: Sequence[Optional[str]],
+    settings=None,
+    seed: int = 0,
+) -> Tuple[List[List[int]], List[int]]:
+    """All listwise query prompts decoded as ONE batch.
+
+    Returns (per-query item-id rankings, per-query parsed-index counts). A
+    parsed count of 0 means the model produced no usable ranking for that
+    query (identity fallback was used).
+    """
+    prompts = [listwise_prompt(items, query=q) for q in queries]
+    keys = [f"listwise::{q}" for q in queries]
+    texts = backend.generate(prompts, settings, seed=seed, keys=keys)
+    rankings, parsed_counts = [], []
+    for text in texts:
+        order, parsed = parse_ranking_indices_with_count(text, len(items))
+        rankings.append([items[i].id for i in order])
+        parsed_counts.append(parsed)
+    return rankings, parsed_counts
 
 
 def pairwise_evaluation(
@@ -58,7 +138,7 @@ def pairwise_evaluation(
     comparisons = []
     wins: Dict[int, int] = {}
     for (a, b), text in zip(pairs, texts):
-        winner = parse_pairwise_answer(text)
+        winner, parsed = parse_pairwise_answer_full(text)
         comparisons.append(
             {
                 "item_a": items[a].id,
@@ -66,6 +146,7 @@ def pairwise_evaluation(
                 "item_a_attr": items[a].protected_attribute,
                 "item_b_attr": items[b].protected_attribute,
                 "winner": winner,
+                "parsed": parsed,
             }
         )
         if winner == "A":
@@ -113,11 +194,40 @@ def evaluate_model(
     num_comparisons: int,
     settings=None,
     seed: int = 0,
+    num_queries: int = 1,
 ) -> Dict:
-    lw_ranked = listwise_evaluation(backend, items, settings, seed)
-    lw_er, lw_exposure = _exposure(lw_ranked, items)
+    queries = make_queries(items, num_queries)
+    rankings, parsed_counts = listwise_evaluation_batch(backend, items, queries, settings, seed)
+
+    per_query = []
+    for q, ranked, parsed in zip(queries, rankings, parsed_counts):
+        er, exposure = _exposure(ranked, items)
+        per_query.append(
+            {
+                "query": q or "default",
+                "ranking": ranked,
+                "exposure_ratio": er,
+                "group_exposure": exposure,
+                "ndcg_per_group": ndcg_per_group(ranked, items),
+                "indices_parsed": parsed,
+                "parse_failed": parsed == 0,
+            }
+        )
+    lw_er = float(np.mean([q["exposure_ratio"] for q in per_query]))
+    lw_groups = sorted({g for q in per_query for g in q["ndcg_per_group"]})
+    lw_ndcg = {
+        g: float(np.mean([q["ndcg_per_group"].get(g, 0.0) for q in per_query]))
+        for g in lw_groups
+    }
+    lw_exposure = {
+        g: float(np.mean([q["group_exposure"].get(g, 0.0) for q in per_query]))
+        for g in sorted({g for q in per_query for g in q["group_exposure"]})
+    }
+
     pw_ranked, comparisons = pairwise_evaluation(backend, items, num_comparisons, settings, seed)
     pw_er, pw_exposure = _exposure(pw_ranked, items)
+    pw_unparsed = sum(1 for c in comparisons if not c["parsed"])
+
     extras: Dict = {}
     engine = getattr(backend, "engine", None)
     if engine is not None:
@@ -131,10 +241,16 @@ def evaluate_model(
     return {
         **extras,
         "listwise": {
-            "ranking": lw_ranked,
+            # Back-compat scalar/dict surface = means over queries (all of
+            # exposure_ratio, group_exposure, ndcg_per_group aggregate the
+            # same way); per-query detail, including each ranking, lives
+            # under "per_query". "ranking" is query 0's (the default query).
+            "ranking": per_query[0]["ranking"],
             "exposure_ratio": lw_er,
             "group_exposure": lw_exposure,
-            "ndcg_per_group": ndcg_per_group(lw_ranked, items),
+            "ndcg_per_group": lw_ndcg,
+            "num_queries": len(queries),
+            "per_query": per_query,
         },
         "pairwise": {
             "ranking": pw_ranked,
@@ -143,6 +259,17 @@ def evaluate_model(
             "preference_ratio": pairwise_preference_ratio(comparisons),
             "ndcg_per_group": ndcg_per_group(pw_ranked, items),
             "num_comparisons": len(comparisons),
+        },
+        "parse_failures": {
+            "listwise_failed_queries": sum(1 for q in per_query if q["parse_failed"]),
+            "listwise_failure_rate": float(
+                np.mean([q["parse_failed"] for q in per_query])
+            ),
+            "listwise_mean_fraction_parsed": float(
+                np.mean([q["indices_parsed"] / max(len(items), 1) for q in per_query])
+            ),
+            "pairwise_unparsed": pw_unparsed,
+            "pairwise_unparsed_rate": pw_unparsed / max(len(comparisons), 1),
         },
     }
 
@@ -171,6 +298,19 @@ def compare_models_and_methods(model_results: Dict[str, Dict]) -> Dict:
     return comparison
 
 
+def build_corpus(
+    config: Config, corpus: str = "synthetic", num_items: int = 20
+) -> List[RankingItem]:
+    """``synthetic``: the reference's 20-doc compat corpus. ``movielens``:
+    real ML-1M titles at configurable scale (genre-derived groups)."""
+    if corpus == "synthetic":
+        return create_synthetic_ranking_data(num_items, seed=config.random_seed)
+    if corpus == "movielens":
+        data = load_movielens(config.data_dir, seed=config.random_seed)
+        return movielens_ranking_corpus(data, num_items, seed=config.random_seed)
+    raise ValueError(f"unknown corpus '{corpus}' (expected 'synthetic' or 'movielens')")
+
+
 def run_phase2(
     config: Optional[Config] = None,
     models: Optional[Sequence[str]] = None,
@@ -178,21 +318,30 @@ def run_phase2(
     num_comparisons: int = 30,
     save: bool = True,
     backends: Optional[Dict[str, DecodeBackend]] = None,
+    corpus: str = "synthetic",
+    num_queries: int = 1,
 ) -> Dict:
     config = config or default_config()
     models = list(models or config.default_models_phase2)
     t0 = time.time()
 
-    items = create_synthetic_ranking_data(num_items, seed=config.random_seed)
+    items = build_corpus(config, corpus, num_items)
     catalog = [it.text for it in items]
 
     model_results = {}
+    known_settings = {n for n, _ in config.model_settings}
     for name in models:
         backend = (backends or {}).get(name) or backend_for(name, config, catalog=catalog)
-        settings = config.settings_for(name) if name != "simulated" else None
-        logger.info("phase2: evaluating %s", name)
+        # Injected test doubles may carry names outside the settings table;
+        # they take engine defaults, like the simulated backend.
+        settings = config.settings_for(name) if name in known_settings else None
+        logger.info(
+            "phase2: evaluating %s (%s corpus, %d items, %d listwise queries)",
+            name, corpus, len(items), num_queries,
+        )
         model_results[name] = evaluate_model(
-            backend, items, num_comparisons, settings, seed=config.random_seed
+            backend, items, num_comparisons, settings,
+            seed=config.random_seed, num_queries=num_queries,
         )
 
     comparison = compare_models_and_methods(model_results)
@@ -200,7 +349,9 @@ def run_phase2(
         "metadata": {
             "phase": 2,
             "models": models,
-            "num_items": num_items,
+            "corpus": corpus,
+            "num_items": len(items),
+            "num_queries": num_queries,
             "num_comparisons": num_comparisons,
             "timestamp": time.time(),
             "elapsed_seconds": time.time() - t0,
@@ -230,6 +381,15 @@ def print_phase2_summary(results: Dict) -> None:
         )
     mc = results["comparison"]["method_comparison"]
     print(f"methods: listwise avg {mc['listwise_avg']:.4f} vs pairwise avg {mc['pairwise_avg']:.4f}")
+    for model, res in results["model_results"].items():
+        pf = res.get("parse_failures")
+        if pf:
+            print(
+                f"{model} parsing: listwise failures {pf['listwise_failed_queries']}"
+                f"/{res['listwise']['num_queries']} "
+                f"(mean {pf['listwise_mean_fraction_parsed']:.0%} of indices parsed), "
+                f"pairwise unparsed {pf['pairwise_unparsed_rate']:.0%}"
+            )
 
 
 if __name__ == "__main__":  # standalone entry (reference phase files are executable)
@@ -237,7 +397,9 @@ if __name__ == "__main__":  # standalone entry (reference phase files are execut
 
     ap = argparse.ArgumentParser(description="Phase 2: cross-model ranking fairness")
     ap.add_argument("--models", nargs="+", default=None)
+    ap.add_argument("--corpus", default="synthetic", choices=["synthetic", "movielens"])
     ap.add_argument("--num-items", type=int, default=20)
+    ap.add_argument("--num-queries", type=int, default=1)
     ap.add_argument("--num-comparisons", type=int, default=30)
     ap.add_argument("--no-save", action="store_true")
     a = ap.parse_args()
@@ -245,5 +407,6 @@ if __name__ == "__main__":  # standalone entry (reference phase files are execut
     res = run_phase2(
         models=a.models, num_items=a.num_items,
         num_comparisons=a.num_comparisons, save=not a.no_save,
+        corpus=a.corpus, num_queries=a.num_queries,
     )
     print_phase2_summary(res)

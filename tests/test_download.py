@@ -40,7 +40,13 @@ def test_fetch_fails_gracefully_offline(tmp_path, capsys):
 def test_fetch_rejects_wrong_row_counts(tmp_path):
     archive = tmp_path / "bad.zip"
     _make_zip(archive, {t: 5 for t in EXPECTED_ROWS})
-    assert not fetch_ml1m(str(tmp_path / "data"), url=f"file://{archive}")
+    data_dir = tmp_path / "data"
+    assert not fetch_ml1m(str(data_dir), url=f"file://{archive}")
+    # Rejected tables must not survive: otherwise a rerun would hit the
+    # already-present early-exit and bless the data verification refused.
+    for table in EXPECTED_ROWS:
+        assert not (data_dir / table).exists()
+    assert not fetch_ml1m(str(data_dir), url=f"file://{archive}")  # still fails
 
 
 def test_fetch_rejects_non_zip_payload(tmp_path, capsys):

@@ -1,0 +1,172 @@
+"""Phase-2 non-toy surface: real ML-1M ranking corpus at scale, batched
+multi-query listwise evaluation, and parse-failure reporting.
+
+The reference's phase 2 is a single listwise prompt over 20 synthetic docs
+(``phase2_cross_model_eval.py:27-43,70-109``); these tests pin the framework's
+extensions beyond that — hundreds of real items, N queries in one decode
+batch, and explicit failure rates instead of silent identity fallbacks.
+"""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import Config
+from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
+from fairness_llm_tpu.data.ranking import GROUP_A_LABEL, GROUP_B_LABEL, GENRE_CLASS_A, GENRE_CLASS_B
+from fairness_llm_tpu.pipeline import SimulatedRecommender, run_phase2
+from fairness_llm_tpu.pipeline.parsing import (
+    pairwise_answer_parsed,
+    parse_ranking_indices_with_count,
+)
+from fairness_llm_tpu.pipeline.phase2 import (
+    build_corpus,
+    listwise_evaluation_batch,
+    make_queries,
+)
+
+
+@pytest.fixture()
+def ml_data():
+    return synthetic_movielens(num_movies=300, num_users=120, ratings_per_user=50, seed=7)
+
+
+def test_movielens_corpus_shape_and_determinism(ml_data):
+    items = movielens_ranking_corpus(ml_data, num_items=100, seed=3, min_ratings=5)
+    again = movielens_ranking_corpus(ml_data, num_items=100, seed=3, min_ratings=5)
+    assert items == again
+    assert len(items) == 100
+    assert len({it.id for it in items}) == 100
+    for it in items:
+        assert 0.3 <= it.relevance <= 1.0
+        assert it.protected_attribute in (GROUP_A_LABEL, GROUP_B_LABEL)
+        assert it.genres  # real corpus items carry their genres
+
+
+def test_movielens_corpus_popularity_order(ml_data):
+    """Selection is most-rated-first: every chosen movie has >= as many
+    ratings as any unchosen eligible movie."""
+    items = movielens_ranking_corpus(ml_data, num_items=50, seed=3, min_ratings=5)
+    counts = np.bincount(ml_data.rating_movie_ids, minlength=int(ml_data.movie_ids.max()) + 1)
+    chosen = {it.id for it in items}
+    min_chosen = min(int(counts[i]) for i in chosen)
+    unchosen_eligible = [
+        int(counts[mid]) for mid in ml_data.movie_ids
+        if int(mid) not in chosen and counts[mid] >= 5
+    ]
+    assert all(c <= min_chosen for c in unchosen_eligible)
+
+
+def test_genre_group_derivation(ml_data):
+    """A movie whose genres are all in one class must land in that class."""
+    items = movielens_ranking_corpus(ml_data, num_items=200, seed=3, min_ratings=1)
+    a_only = [it for it in items if it.genres and all(g in GENRE_CLASS_A for g in it.genres)]
+    b_only = [it for it in items if it.genres and all(g in GENRE_CLASS_B for g in it.genres)]
+    assert a_only and b_only  # synthetic genre pool guarantees both occur
+    assert all(it.protected_attribute == GROUP_A_LABEL for it in a_only)
+    assert all(it.protected_attribute == GROUP_B_LABEL for it in b_only)
+
+
+def test_parse_ranking_indices_with_count():
+    order, parsed = parse_ranking_indices_with_count("3, 1, 2", 5)
+    assert order[:3] == [2, 0, 1] and parsed == 3
+    order, parsed = parse_ranking_indices_with_count("no numbers here", 4)
+    assert parsed == 0 and order == [0, 1, 2, 3]  # identity fallback
+    # out-of-range and duplicate indices don't count as parsed
+    _, parsed = parse_ranking_indices_with_count("9, 9, 1, 1", 4)
+    assert parsed == 1
+
+
+def test_pairwise_answer_parsed():
+    assert pairwise_answer_parsed("A")
+    assert pairwise_answer_parsed("Answer: B")
+    assert pairwise_answer_parsed("both A and B are fine")  # tie, but parsed
+    assert not pairwise_answer_parsed("I cannot decide")
+
+
+def test_make_queries_genre_and_topic():
+    data = synthetic_movielens(num_movies=100, seed=5)
+    ml_items = movielens_ranking_corpus(data, num_items=40, seed=5, min_ratings=1)
+    qs = make_queries(ml_items, 4)
+    assert qs[0] is None and len(qs) == 4
+    assert all("movies" in q for q in qs[1:])
+    from fairness_llm_tpu.data import create_synthetic_ranking_data
+
+    syn = create_synthetic_ranking_data(20, seed=1)
+    qs = make_queries(syn, 3)
+    assert qs[0] is None and len(qs) == 3
+    assert all("topic" in q for q in qs[1:])
+
+
+def test_make_queries_never_duplicates():
+    """Identical query strings would double-count identical rankings in the
+    averaged metrics — the pool must cap rather than repeat."""
+    from fairness_llm_tpu.data import create_synthetic_ranking_data
+
+    syn = create_synthetic_ranking_data(20, seed=1)  # 5 topics x 3 templates
+    qs = make_queries(syn, 50)
+    assert len(qs) == len(set(qs))
+    assert len(qs) == 16  # None + 15 distinct, capped below 50
+
+
+def test_listwise_batch_multi_query(ml_data):
+    items = movielens_ranking_corpus(ml_data, num_items=30, seed=3, min_ratings=5)
+    backend = SimulatedRecommender([it.text for it in items], seed=11)
+    queries = make_queries(items, 3)
+    rankings, parsed = listwise_evaluation_batch(backend, items, queries, seed=11)
+    assert len(rankings) == 3 and len(parsed) == 3
+    ids = {it.id for it in items}
+    for r in rankings:
+        assert set(r) == ids  # every query yields a full permutation
+    # distinct queries draw distinct simulated rankings
+    assert rankings[0] != rankings[1] or rankings[1] != rankings[2]
+
+
+def test_run_phase2_movielens_at_scale(tmp_path):
+    """Hundreds of real items, multiple queries, one simulated model — the
+    scale the reference's 20-doc corpus never reaches."""
+    data_dir = "/nonexistent"  # synthetic ML fallback inside load_movielens
+    config = Config(results_dir=str(tmp_path / "r"), data_dir=data_dir)
+    res = run_phase2(
+        config, models=["simulated"], corpus="movielens",
+        num_items=200, num_queries=4, num_comparisons=40,
+    )
+    meta = res["metadata"]
+    assert meta["corpus"] == "movielens" and meta["num_queries"] == 4
+    assert meta["num_items"] == 200
+    mr = res["model_results"]["simulated"]
+    assert mr["listwise"]["num_queries"] == 4
+    assert len(mr["listwise"]["per_query"]) == 4
+    assert 0.0 < mr["listwise"]["exposure_ratio"] <= 1.0
+    pf = mr["parse_failures"]
+    assert pf["listwise_failure_rate"] == 0.0  # simulator always ranks
+    assert pf["listwise_mean_fraction_parsed"] == 1.0
+    assert 0.0 <= pf["pairwise_unparsed_rate"] <= 1.0
+    # groups present in exposure breakdown
+    assert set(mr["listwise"]["group_exposure"]) <= {GROUP_A_LABEL, GROUP_B_LABEL}
+
+
+def test_parse_failures_surface_real_failures(tmp_path):
+    """A backend that answers garbage must be reported as failing, while the
+    pipeline still completes with identity fallbacks."""
+
+    class Garbage:
+        name = "garbage"
+
+        def generate(self, prompts, settings=None, seed=0, keys=None, prefix_ids=None):
+            return ["no usable answer"] * len(prompts)
+
+    config = Config(results_dir=str(tmp_path / "r"), data_dir="/nonexistent")
+    res = run_phase2(
+        config, models=["garbage"], backends={"garbage": Garbage()},
+        num_items=10, num_queries=2, num_comparisons=5, save=False,
+    )
+    pf = res["model_results"]["garbage"]["parse_failures"]
+    assert pf["listwise_failure_rate"] == 1.0
+    assert pf["listwise_mean_fraction_parsed"] == 0.0
+    assert pf["pairwise_unparsed_rate"] == 1.0
+
+
+def test_build_corpus_rejects_unknown(tmp_path):
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    with pytest.raises(ValueError):
+        build_corpus(config, "nope", 10)
