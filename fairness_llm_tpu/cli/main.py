@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--calibration", default="simulated", choices=("simulated", "model"),
                    help="phase-3 conformal confidences: reference-style simulated "
                         "curve, or the model's own title likelihoods")
+    p.add_argument("--confidence-mapping", default="percentile",
+                   choices=("percentile", "probability"),
+                   help="with --calibration model: how likelihoods map onto the "
+                        "conformal scale (rank-normalized, or temperature-scaled "
+                        "probabilities — see pipeline.facter.model_confidences)")
+    p.add_argument("--confidence-temperature", type=float, default=1.0,
+                   help="temperature for --confidence-mapping probability")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--data-dir", default=None, help="MovieLens-1M directory")
@@ -176,7 +183,9 @@ def main(argv=None) -> int:
                 p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
                                 num_profiles=args.profiles, variant=args.variant,
                                 strategy=args.strategy, save=save,
-                                calibration=args.calibration)
+                                calibration=args.calibration,
+                                confidence_mapping=args.confidence_mapping,
+                                confidence_temperature=args.confidence_temperature)
                 print_phase3_summary(p3)
                 if save:
                     from fairness_llm_tpu.reports import generate_phase3_figure

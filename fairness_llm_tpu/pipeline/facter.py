@@ -63,6 +63,50 @@ def nonconformity_from_confidence(conf: np.ndarray, seed: int) -> np.ndarray:
     return np.abs(conf - actual).astype(np.float32)
 
 
+def model_confidences(
+    mean_logprobs: np.ndarray, mapping: str = "percentile", temperature: float = 1.0
+) -> np.ndarray:
+    """Map per-title mean log-probs onto the conformal confidence scale.
+
+    Why a mapping at all: conformal thresholds are quantiles of
+    ``|conf - clip(conf + N(0, 0.1))|`` — numbers around 0.08-0.2 — while a
+    raw per-token likelihood ``exp(mean_logprob)`` for a movie title lives at
+    ~1e-2. Comparing those directly would put every title below every
+    threshold and floor-truncate every list to 3 items. Both mappings put
+    model scores on the [0, 1] scale the thresholds live on:
+
+    - ``"percentile"`` (default): rank-normalize — title at global rank r of
+      n gets r/(n-1). Scale-free and distribution-free; preserves the model's
+      ORDERING exactly, which is the only property conformal quantile
+      thresholds consume. The filter then keeps each profile's titles that
+      sit above the ~alpha-ish bottom percentile globally.
+    - ``"probability"``: temperature-scaled probabilities
+      ``exp(mean_logprob / temperature)``, min-max normalized over the batch.
+      Preserves relative likelihood GAPS (a title 10x less likely lands far
+      below its neighbor, not one rank below) at the cost of sensitivity to
+      outliers — one very unlikely title compresses everything else toward 1.
+
+    Ties in ``mean_logprobs`` map to the stable-argsort order (first
+    occurrence ranks lower) under ``"percentile"``; identical values under
+    ``"probability"``.
+    """
+    lp = np.asarray(mean_logprobs, np.float64)
+    if lp.size == 0:
+        return np.zeros(0, np.float32)
+    if mapping == "percentile":
+        order = np.argsort(np.argsort(lp, kind="stable"), kind="stable")
+        return (order / max(lp.size - 1, 1)).astype(np.float32)
+    if mapping == "probability":
+        if temperature <= 0:
+            raise ValueError("temperature must be positive")
+        p = np.exp(lp / temperature)
+        lo, hi = p.min(), p.max()
+        if hi - lo < 1e-12:
+            return np.full(lp.shape, 0.5, np.float32)
+        return ((p - lo) / (hi - lo)).astype(np.float32)
+    raise ValueError(f"unknown confidence mapping '{mapping}' (percentile|probability)")
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups",))
 def conformal_thresholds_kernel(
     nonconformity: jnp.ndarray,  # [N]

@@ -37,6 +37,7 @@ from fairness_llm_tpu.pipeline.facter import (
     conformal_filter_mask,
     conformal_keep_counts,
     conformal_thresholds_kernel,
+    model_confidences,
     nonconformity_from_confidence,
     simulate_calibration,
     smart_balance,
@@ -78,13 +79,19 @@ def apply_facter(
     settings=None,
     save_checkpoints: bool = True,
     calibration: str = "simulated",
+    confidence_mapping: str = "percentile",
+    confidence_temperature: float = 1.0,
 ) -> Dict[str, List[str]]:
     """Fair re-prompting + conformal filtering -> {pid: mitigated rec list}.
 
     ``calibration``: "simulated" reproduces the reference's rank-decreasing
     confidence curve (``1 - 0.05*rank``); "model" derives each item's
     confidence from the backend model's own likelihood of the title
-    (``runtime/scoring.py``) — requires an EngineBackend."""
+    (``runtime/scoring.py``) — requires an EngineBackend.
+    ``confidence_mapping``: how model likelihoods land on the conformal
+    confidence scale — see ``facter.model_confidences`` for the semantics of
+    "percentile" (rank-normalized, default) vs "probability"
+    (temperature-scaled by ``confidence_temperature``)."""
     anonymize = variant in ("smart", "aggressive")
     prompts = [
         fairness_aware_prompt(
@@ -129,15 +136,9 @@ def apply_facter(
             sc = score_texts(engine, unique_titles)
             lp_of = dict(zip(unique_titles, sc.mean_logprobs))
             lp_flat = np.array([lp_of[t] for t in all_titles], np.float64)
-            # Rank-normalize likelihoods to [0, 1]: raw exp(mean_logprob)
-            # lives at ~1e-2 scale while conformal thresholds are quantiles of
-            # |conf - (conf + N(0, 0.1))| at ~0.15 scale — comparing those
-            # directly would floor-truncate every list. Percentiles put model
-            # confidence on the simulated curve's scale with the model's
-            # ORDERING intact, which is the signal that matters.
-            order = np.argsort(np.argsort(lp_flat, kind="stable"), kind="stable")
-            denom = max(len(lp_flat) - 1, 1)
-            conf = (order / denom).astype(np.float32)
+            conf = model_confidences(
+                lp_flat, mapping=confidence_mapping, temperature=confidence_temperature
+            )
         else:
             conf = np.zeros(0, np.float32)
         conf_rows = np.split(conf, np.cumsum(lengths)[:-1]) if len(pids) else []
@@ -240,6 +241,8 @@ def run_phase3(
     save: bool = True,
     backend: Optional[DecodeBackend] = None,
     calibration: str = "simulated",
+    confidence_mapping: str = "percentile",
+    confidence_temperature: float = 1.0,
 ) -> Dict:
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}")
@@ -290,6 +293,8 @@ def run_phase3(
     mitigated = apply_facter(
         profiles, backend, config, strategy, variant, settings,
         save_checkpoints=save, calibration=calibration,
+        confidence_mapping=confidence_mapping,
+        confidence_temperature=confidence_temperature,
     )
 
     if variant in ("smart", "aggressive"):
@@ -322,6 +327,7 @@ def run_phase3(
             "variant": variant,
             "strategy": strategy,
             "calibration": calibration,
+            "confidence_mapping": confidence_mapping if calibration == "model" else None,
             "model": backend.name,
             "num_profiles": len(profiles),
             "timestamp": time.time(),
@@ -368,11 +374,17 @@ if __name__ == "__main__":  # standalone entry (reference phase files are execut
     ap.add_argument("--profiles", type=int, default=None)
     ap.add_argument("--variant", default="conformal", choices=VARIANTS)
     ap.add_argument("--strategy", default="demographic_parity")
+    ap.add_argument("--calibration", default="simulated", choices=("simulated", "model"))
+    ap.add_argument("--confidence-mapping", default="percentile",
+                    choices=("percentile", "probability"))
+    ap.add_argument("--confidence-temperature", type=float, default=1.0)
     ap.add_argument("--no-save", action="store_true")
     a = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
     res = run_phase3(
         model_name=a.model, num_profiles=a.profiles, variant=a.variant,
-        strategy=a.strategy, save=not a.no_save,
+        strategy=a.strategy, save=not a.no_save, calibration=a.calibration,
+        confidence_mapping=a.confidence_mapping,
+        confidence_temperature=a.confidence_temperature,
     )
     print_phase3_summary(res)

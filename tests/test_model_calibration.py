@@ -1,0 +1,233 @@
+"""Value-level semantics of phase-3 ``calibration="model"``.
+
+VERDICT/round-1 flagged that the model-derived conformal path was tested only
+for shapes. These tests pin WHAT the filter keeps for known logprob patterns:
+
+- ``facter.model_confidences`` mappings (percentile / probability) on known
+  inputs,
+- the full ``apply_facter(calibration="model")`` path with a stubbed scorer:
+  the kept set must be exactly the titles whose mapped confidence clears the
+  per-gender conformal threshold (floor 3), i.e. low-likelihood titles are
+  the ones dropped.
+"""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import Config
+from fairness_llm_tpu.data.profiles import Profile
+from fairness_llm_tpu.pipeline.facter import model_confidences
+from fairness_llm_tpu.pipeline.phase3 import apply_facter
+
+
+# ---------------------------------------------------------------------------
+# mapping unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_mapping_known_pattern():
+    # ranks of [-1, -5, -3] are [2, 0, 1] -> /2 -> [1.0, 0.0, 0.5]
+    conf = model_confidences(np.array([-1.0, -5.0, -3.0]))
+    np.testing.assert_allclose(conf, [1.0, 0.0, 0.5])
+
+
+def test_percentile_mapping_is_scale_free():
+    lp = np.array([-2.0, -9.0, -4.5, -0.1])
+    np.testing.assert_allclose(
+        model_confidences(lp), model_confidences(lp * 100.0)
+    )
+
+
+def test_probability_mapping_preserves_gaps():
+    # logprobs -0.1 and -0.2 are near each other; -8 is an outlier.
+    # percentile spaces them evenly; probability keeps the near pair close.
+    lp = np.array([-0.1, -0.2, -8.0])
+    pct = model_confidences(lp, "percentile")
+    prob = model_confidences(lp, "probability")
+    assert pct[0] - pct[1] == pytest.approx(0.5)  # even rank spacing
+    assert prob[0] - prob[1] < 0.15  # near pair stays near
+    assert prob[2] == 0.0 and prob[0] == 1.0  # min-max endpoints
+    # both mappings preserve ordering
+    assert list(np.argsort(pct)) == list(np.argsort(prob)) == [2, 1, 0]
+
+
+def test_probability_mapping_temperature():
+    lp = np.array([-0.1, -0.2, -8.0])
+    hot = model_confidences(lp, "probability", temperature=10.0)
+    cold = model_confidences(lp, "probability", temperature=0.5)
+    # low temperature sharpens the distribution: after min-max normalization
+    # the near pair sits FURTHER apart than at high temperature (where all
+    # probabilities converge and the normalized gap shrinks)
+    assert (cold[0] - cold[1]) > (hot[0] - hot[1])
+    # ordering invariant under temperature
+    assert list(np.argsort(hot)) == list(np.argsort(cold)) == [2, 1, 0]
+
+
+def test_mapping_edge_cases():
+    assert model_confidences(np.zeros(0)).shape == (0,)
+    np.testing.assert_allclose(model_confidences(np.array([-3.0, -3.0]), "probability"), [0.5, 0.5])
+    with pytest.raises(ValueError):
+        model_confidences(np.array([-1.0]), "nope")
+    with pytest.raises(ValueError):
+        model_confidences(np.array([-1.0]), "probability", temperature=0.0)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end kept-set semantics through apply_facter
+# ---------------------------------------------------------------------------
+
+TITLES = {
+    "m0": [f"M{i}" for i in range(6)],
+    "f0": [f"F{i}" for i in range(6)],
+}
+# Known logprob pattern: within each list, title i gets logprob -(i+1) for M,
+# offset by -0.5 for F — so the global likelihood order interleaves
+# M0 > F0 > M1 > F1 > ... > M5 > F5 and low-rank titles are the UNLIKELY ones.
+LOGPROBS = {f"M{i}": -(i + 1.0) for i in range(6)}
+LOGPROBS.update({f"F{i}": -(i + 1.5) for i in range(6)})
+
+
+class _ByteTokenizer:
+    def encode(self, text):
+        return list(text.encode("utf-8"))
+
+
+class _StubEngine:
+    tokenizer = _ByteTokenizer()
+
+
+class StubBackend:
+    """Returns each profile's fixed numbered list; exposes a truthy .engine
+    (with the tokenizer the shared-prefix probe needs) so apply_facter takes
+    the model-calibration path."""
+
+    name = "stub"
+    engine = _StubEngine()
+
+    def generate(self, prompts, settings=None, seed=0, keys=None, prefix_ids=None):
+        return ["\n".join(f"{j + 1}. {t}" for j, t in enumerate(TITLES[k])) for k in keys]
+
+
+@pytest.fixture()
+def profiles():
+    return [
+        Profile(id="m0", gender="male", age="25-34", occupation="pro",
+                watched_movies=["w"], favorite_genres=["Drama"], avg_rating=4.5),
+        Profile(id="f0", gender="female", age="25-34", occupation="pro",
+                watched_movies=["w"], favorite_genres=["Drama"], avg_rating=4.5),
+    ]
+
+
+def _patch_scorer(monkeypatch):
+    import fairness_llm_tpu.runtime.scoring as scoring
+
+    class FakeScores:
+        def __init__(self, titles):
+            self.mean_logprobs = [LOGPROBS[t] for t in titles]
+
+    monkeypatch.setattr(scoring, "score_texts", lambda engine, texts: FakeScores(texts))
+
+
+def _expected_keep(pids, genders_of, mapping, config):
+    """Independently recompute the kept sets from the pinned semantics:
+    flatten confidences in profile order, per-gender conformal threshold on
+    seeded nonconformity, keep conf >= threshold with floor 3 (top-by-conf)."""
+    import jax.numpy as jnp
+
+    from fairness_llm_tpu.pipeline.facter import (
+        conformal_thresholds_kernel,
+        nonconformity_from_confidence,
+    )
+
+    all_titles = [t for pid in pids for t in TITLES[pid]]
+    conf = model_confidences(np.array([LOGPROBS[t] for t in all_titles]), mapping)
+    nonconf = nonconformity_from_confidence(conf, config.random_seed)
+    genders = sorted({genders_of[p] for p in pids})
+    gidx = {g: i for i, g in enumerate(genders)}
+    groups = np.concatenate([np.full(6, gidx[genders_of[p]], np.int32) for p in pids])
+    thresholds = np.asarray(
+        conformal_thresholds_kernel(jnp.asarray(nonconf), jnp.asarray(groups),
+                                    len(genders), alpha=config.conformal_alpha)
+    )
+    out = {}
+    off = 0
+    for pid in pids:
+        row_conf = conf[off: off + 6]
+        t = thresholds[gidx[genders_of[pid]]]
+        kept = [TITLES[pid][j] for j in range(6) if row_conf[j] >= t]
+        if len(kept) < 3:  # floor: top-3 by confidence
+            top = np.argsort(-row_conf, kind="stable")[:3]
+            kept = [TITLES[pid][j] for j in sorted(top)]
+        out[pid] = kept
+        off += 6
+    return out
+
+
+@pytest.mark.parametrize("mapping", ["percentile", "probability"])
+def test_model_calibration_keeps_high_likelihood_titles(profiles, monkeypatch, tmp_path, mapping):
+    _patch_scorer(monkeypatch)
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    kept = apply_facter(
+        profiles, StubBackend(), config, variant="conformal",
+        save_checkpoints=False, calibration="model", confidence_mapping=mapping,
+    )
+    expected = _expected_keep(
+        ["m0", "f0"], {"m0": "male", "f0": "female"}, mapping, config
+    )
+    assert kept == expected
+    # semantic floor: every kept list has >= 3 titles, order preserved
+    for pid, lst in kept.items():
+        assert len(lst) >= 3
+        idx = [TITLES[pid].index(t) for t in lst]
+        assert idx == sorted(idx)
+    # dropped titles are always lower-likelihood than every kept title of the
+    # same profile (both mappings are monotone in logprob)
+    for pid, lst in kept.items():
+        dropped = [t for t in TITLES[pid] if t not in lst]
+        if dropped:
+            assert max(LOGPROBS[t] for t in dropped) < min(LOGPROBS[t] for t in lst)
+
+
+def test_model_calibration_golden_kept_set(profiles, monkeypatch, tmp_path):
+    """Hard-pinned kept titles for the canonical pattern (percentile mapping,
+    seed 42, alpha 0.1): any change to the mapping, threshold kernel, filter
+    semantics, or seeding shows up as a diff here."""
+    _patch_scorer(monkeypatch)
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    kept = apply_facter(
+        profiles, StubBackend(), config, variant="conformal",
+        save_checkpoints=False, calibration="model",
+    )
+    assert kept == GOLDEN_KEPT
+
+
+def test_confidence_temperature_reaches_mapping(profiles, monkeypatch, tmp_path):
+    """run_phase3's confidence_temperature must reach model_confidences (it
+    was once accepted-but-dropped)."""
+    _patch_scorer(monkeypatch)
+    seen = {}
+    import fairness_llm_tpu.pipeline.phase3 as p3
+
+    real = model_confidences
+
+    def spy(lp, mapping="percentile", temperature=1.0):
+        seen["mapping"], seen["temperature"] = mapping, temperature
+        return real(lp, mapping, temperature)
+
+    monkeypatch.setattr(p3, "model_confidences", spy)
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    apply_facter(
+        profiles, StubBackend(), config, variant="conformal",
+        save_checkpoints=False, calibration="model",
+        confidence_mapping="probability", confidence_temperature=2.5,
+    )
+    assert seen == {"mapping": "probability", "temperature": 2.5}
+
+
+# Populated from a verified run of the pinned semantics (see
+# test_model_calibration_keeps_high_likelihood_titles, which derives the same
+# sets independently); hard-coded so regressions are visible as literal diffs.
+GOLDEN_KEPT = {
+    "m0": ["M0", "M1", "M2", "M3", "M4"],
+    "f0": ["F0", "F1", "F2", "F3", "F4"],
+}
