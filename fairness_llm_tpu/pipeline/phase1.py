@@ -23,6 +23,7 @@ TPU-first deltas vs the reference:
 from __future__ import annotations
 
 import logging
+import sys
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -39,6 +40,7 @@ from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
 from fairness_llm_tpu.pipeline.parsing import canonicalize, parse_numbered_list
 from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+from fairness_llm_tpu.utils.progress import print_progress
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +70,11 @@ def decode_sweep(
     prefix_ids = shared_prefix_ids(backend, list(prompts))
     done = dict(done or {})
     chunk = max(config.decode_batch_size, 1)
+    # Interactive runs get the reference's live carriage-return bar; the
+    # per-chunk log line then drops to DEBUG so it can't splice into the
+    # un-newlined bar. Piped/driver runs keep the INFO lines and no bar.
+    interactive = getattr(sys.stderr, "isatty", lambda: False)()
+    last_drawn = -1
     # Chunk over ABSOLUTE positions in the full prompt list (not the remaining
     # todo list) so each chunk's decode seed is identical whether or not the
     # run was resumed mid-sweep — resume must not change sampling.
@@ -98,7 +105,16 @@ def decode_sweep(
             # Failed entries stay out of checkpoints so --resume retries them.
             ok = {k: v for k, v in done.items() if "error" not in v}
             R.save_checkpoint(ok, config.results_dir, phase, completed)
-        logger.info("%s sweep: %d/%d decoded", phase, completed, len(keys))
+        if interactive:
+            logger.debug("%s sweep: %d/%d decoded", phase, completed, len(keys))
+            print_progress(completed, len(keys), prefix=f"{phase} ")
+            last_drawn = completed
+        else:
+            logger.info("%s sweep: %d/%d decoded", phase, completed, len(keys))
+    if 0 <= last_drawn < len(keys):
+        # A resume whose tail chunks were all cached leaves the bar mid-line;
+        # finish it so subsequent stderr output starts on a fresh line.
+        print_progress(len(keys), len(keys), prefix=f"{phase} ")
     return {k: done[k] for k in keys if k in done}
 
 

@@ -1,0 +1,28 @@
+"""Carriage-return text progress bar (reference ``print_progress``,
+``utils.py:411-419``; reimplemented inline at ``phase3_final.py:170-174`` and
+``phase3_aggressive.py:224-229`` — one shared implementation here).
+
+Used by the decode sweep alongside the per-chunk log lines: the bar renders
+only when stderr is an interactive terminal, so piped/driver runs keep clean
+logs while a human watching a sweep gets the reference's live bar.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def print_progress(current: int, total: int, prefix: str = "", width: int = 40,
+                   stream=None) -> None:
+    """Render ``prefix [####----] current/total`` in place via carriage return;
+    emits a newline when complete. No-op for non-TTY streams and total <= 0."""
+    out = stream if stream is not None else sys.stderr
+    if total <= 0 or not getattr(out, "isatty", lambda: False)():
+        return
+    frac = min(max(current / total, 0.0), 1.0)
+    filled = int(width * frac)
+    bar = "#" * filled + "-" * (width - filled)
+    out.write(f"\r{prefix}[{bar}] {current}/{total}")
+    if current >= total:
+        out.write("\n")
+    out.flush()

@@ -1,0 +1,40 @@
+"""utils/progress.py: carriage-return bar rendering and TTY gating."""
+
+import io
+
+from fairness_llm_tpu.utils.progress import print_progress
+
+
+class _Tty(io.StringIO):
+    def isatty(self):
+        return True
+
+
+def test_renders_on_tty():
+    out = _Tty()
+    print_progress(5, 10, prefix="p1 ", width=10, stream=out)
+    s = out.getvalue()
+    assert s.startswith("\rp1 [")
+    assert "#####-----" in s and "5/10" in s
+    assert not s.endswith("\n")
+
+
+def test_newline_on_completion():
+    out = _Tty()
+    print_progress(10, 10, width=10, stream=out)
+    assert out.getvalue().endswith("\n")
+    assert "##########" in out.getvalue()
+
+
+def test_silent_when_not_a_tty():
+    out = io.StringIO()
+    print_progress(5, 10, stream=out)
+    assert out.getvalue() == ""
+
+
+def test_silent_on_zero_total_and_clamps():
+    out = _Tty()
+    print_progress(5, 0, stream=out)
+    assert out.getvalue() == ""
+    print_progress(15, 10, width=10, stream=out)  # clamps past-total
+    assert "##########" in out.getvalue()
