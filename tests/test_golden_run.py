@@ -1,0 +1,87 @@
+"""Regression guard for the framework's own committed golden run
+(``results/`` at the repo root — see ``results/README.md``).
+
+Re-runs the deterministic simulated study with the same defaults and asserts
+the headline metrics match the committed record. Any change to prompts,
+simulator entropy, parsing, metric kernels, sweep chunking, or seeding that
+shifts the numbers fails here — the same role the reference's committed
+``results/*.json`` played for its README claims.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from fairness_llm_tpu.config import Config
+from fairness_llm_tpu.pipeline import run_phase1, run_phase3
+
+GOLDEN_DIR = pathlib.Path(__file__).resolve().parent.parent / "results"
+
+ATOL = 1e-4  # float32 metric kernels
+
+
+@pytest.fixture(scope="module")
+def golden_phase1():
+    path = GOLDEN_DIR / "phase1" / "phase1_results.json"
+    if not path.exists():
+        pytest.skip("no committed golden run")
+    with open(path) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def fresh_phase1(tmp_path_factory):
+    data_dir = pathlib.Path(__file__).resolve().parent.parent / "data" / "ml-1m"
+    if (data_dir / "movies.dat").exists():
+        pytest.skip(
+            "real ML-1M present: the committed record was produced on the "
+            "synthetic fallback — regenerate results/ (see results/README.md)"
+        )
+    config = Config(
+        results_dir=str(tmp_path_factory.mktemp("golden")), data_dir=str(data_dir)
+    )
+    return config, run_phase1(config, model_name="simulated", save=False)
+
+
+def test_phase1_metrics_match_committed_record(golden_phase1, fresh_phase1):
+    _, fresh = fresh_phase1
+    g, f = golden_phase1["metrics"], fresh["metrics"]
+    assert f["demographic_parity_gender"]["score"] == pytest.approx(
+        g["demographic_parity_gender"]["score"], abs=ATOL
+    )
+    assert f["demographic_parity_age"]["score"] == pytest.approx(
+        g["demographic_parity_age"]["score"], abs=ATOL
+    )
+    assert f["individual_fairness"]["score"] == pytest.approx(
+        g["individual_fairness"]["score"], abs=ATOL
+    )
+    assert f["equal_opportunity"]["score"] == pytest.approx(
+        g["equal_opportunity"]["score"], abs=ATOL
+    )
+    assert f["snsr_snsv"]["snsr"] == pytest.approx(g["snsr_snsv"]["snsr"], abs=ATOL)
+    assert f["snsr_snsv"]["snsv"] == pytest.approx(g["snsr_snsv"]["snsv"], abs=ATOL)
+
+
+def test_phase1_recommendations_match_committed_record(golden_phase1, fresh_phase1):
+    """Decoded text, not just aggregates: the sweep is end-to-end deterministic."""
+    _, fresh = fresh_phase1
+    g_recs = golden_phase1["recommendations"]
+    f_recs = fresh["recommendations"]
+    assert set(g_recs) == set(f_recs)
+    for pid in g_recs:
+        assert g_recs[pid]["recommendations"] == f_recs[pid]["recommendations"], pid
+
+
+def test_phase3_conformal_matches_committed_record(fresh_phase1):
+    path = GOLDEN_DIR / "phase3" / "phase3_results.json"
+    if not path.exists():
+        pytest.skip("no committed golden run")
+    with open(path) as f:
+        golden = json.load(f)
+    config, p1 = fresh_phase1
+    fresh = run_phase3(config, phase1_results=p1, model_name="simulated", save=False)
+    gb, fb = golden["bias_reduction"], fresh["bias_reduction"]
+    assert fb["original_fairness"] == pytest.approx(gb["original_fairness"], abs=ATOL)
+    assert fb["mitigated_fairness"] == pytest.approx(gb["mitigated_fairness"], abs=ATOL)
+    assert fb["bias_reduction_rate"] == pytest.approx(gb["bias_reduction_rate"], abs=1e-2)
