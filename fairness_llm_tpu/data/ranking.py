@@ -80,7 +80,9 @@ def movielens_ranking_corpus(
     eligible = [
         (int(counts[mid]), int(mid), i)
         for i, mid in enumerate(data.movie_ids)
-        if counts[mid] >= min_ratings
+        # count > 0 even when min_ratings <= 0: unrated movies have no mean
+        # rating to derive relevance from
+        if counts[mid] >= min_ratings and counts[mid] > 0
     ]
     # Most-rated first; movie id breaks ties deterministically.
     eligible.sort(key=lambda t: (-t[0], t[1]))

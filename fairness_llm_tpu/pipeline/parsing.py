@@ -100,11 +100,6 @@ def parse_pairwise_answer(text: str) -> str:
     return parse_pairwise_answer_full(text)[0]
 
 
-def pairwise_answer_parsed(text: str) -> bool:
-    """Whether a comparison reply contains a recognizable choice token at all."""
-    return parse_pairwise_answer_full(text)[1]
-
-
 def canonical_title(title: str) -> str:
     """Normalize a movie title for set matching: strip year, articles, case."""
     t = _YEAR_SUFFIX.sub("", title.strip())

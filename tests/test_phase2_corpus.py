@@ -15,7 +15,7 @@ from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
 from fairness_llm_tpu.data.ranking import GROUP_A_LABEL, GROUP_B_LABEL, GENRE_CLASS_A, GENRE_CLASS_B
 from fairness_llm_tpu.pipeline import SimulatedRecommender, run_phase2
 from fairness_llm_tpu.pipeline.parsing import (
-    pairwise_answer_parsed,
+    parse_pairwise_answer_full,
     parse_ranking_indices_with_count,
 )
 from fairness_llm_tpu.pipeline.phase2 import (
@@ -76,11 +76,11 @@ def test_parse_ranking_indices_with_count():
     assert parsed == 1
 
 
-def test_pairwise_answer_parsed():
-    assert pairwise_answer_parsed("A")
-    assert pairwise_answer_parsed("Answer: B")
-    assert pairwise_answer_parsed("both A and B are fine")  # tie, but parsed
-    assert not pairwise_answer_parsed("I cannot decide")
+def test_pairwise_answer_parsed_flag():
+    assert parse_pairwise_answer_full("A") == ("A", True)
+    assert parse_pairwise_answer_full("Answer: B") == ("B", True)
+    assert parse_pairwise_answer_full("both A and B are fine") == ("tie", True)
+    assert parse_pairwise_answer_full("I cannot decide") == ("tie", False)
 
 
 def test_make_queries_genre_and_topic():
