@@ -75,6 +75,63 @@ def build_sweep_prompts():
     return [recommendation_prompt(p) for p in profiles]
 
 
+def build_listwise_prompts(num_items: int = 60, num_queries: int = 4):
+    """Phase-2 at scale: long listwise ranking prompts (hundreds of items),
+    several queries decoded as one batch — the prefill-heavy counterpart to
+    the decode-heavy phase-1 sweep."""
+    from fairness_llm_tpu.config import default_config
+    from fairness_llm_tpu.data import load_movielens, movielens_ranking_corpus
+    from fairness_llm_tpu.pipeline.phase2 import make_queries
+    from fairness_llm_tpu.pipeline.prompts import listwise_prompt
+
+    config = default_config()
+    data = load_movielens(config.data_dir, seed=config.random_seed)
+    items = movielens_ranking_corpus(data, num_items, seed=config.random_seed, min_ratings=1)
+    queries = make_queries(items, num_queries)
+    return [listwise_prompt(items, query=q) for q in queries], len(items)
+
+
+def measure_phase2_listwise(config, settings_cls) -> dict | None:
+    """Queries/sec for the long-prompt listwise batch, flash vs dense prefill.
+
+    The phase-1 sweep is decode-bound (prefill is an amortized sliver), so the
+    flash prefill kernel doesn't move that number; THIS workload is where it
+    runs in a headline path. gpt2-small's 1024 learned positions can't hold a
+    60-item byte-tokenized prompt (~2.5k tokens); the bench widens the table
+    (random weights — FLOPs and memory traffic are representative either way).
+    Corpus size is capped so the DENSE comparison's [B, H, S, S] score tensor
+    stays well under chip HBM; flash itself scales much further.
+    """
+    import dataclasses
+
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    prompts, num_items = build_listwise_prompts()
+    long_cfg = dataclasses.replace(config, max_seq_len=4096, kv_cache_quant=False)
+    settings = settings_cls(temperature=0.7, top_k=0, top_p=1.0, max_tokens=32)
+
+    out = {}
+    for label, flash in (("flash", True), ("dense", False)):
+        eng = DecodeEngine(
+            dataclasses.replace(long_cfg, use_flash_attention=flash), seed=0
+        )
+        res = eng.generate(prompts, settings, seed=0)  # warmup/compile
+        t0 = time.perf_counter()
+        res = eng.generate(prompts, settings, seed=1)
+        jax.block_until_ready(res.tokens)
+        wall = time.perf_counter() - t0
+        out[label] = {
+            "wall_s": round(wall, 3),
+            "queries_per_sec": round(len(prompts) / wall, 3),
+            "decode_shape": res.stats,
+        }
+        del eng
+    out["num_items"] = num_items
+    out["num_queries"] = len(prompts)
+    out["flash_speedup"] = round(out["dense"]["wall_s"] / out["flash"]["wall_s"], 3)
+    return out
+
+
 def main() -> None:
     from fairness_llm_tpu.config import ModelSettings
     from fairness_llm_tpu.models.configs import get_model_config
@@ -137,20 +194,28 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"large-sweep measurement skipped: {type(e).__name__}", file=sys.stderr)
 
-    best = min(times)
-    # The decode program runs on a single chip (no mesh in this bench), so
-    # total throughput == per-chip throughput.
-    profiles_per_sec = len(prompts) / best
-    tokens_per_sec = len(prompts) * MAX_NEW_TOKENS / best
-
     # Roofline accounting: decode is HBM-bound, so achieved bandwidth over the
     # analytic bytes/step IS the utilization number. Random weights never
     # sample EOS, so the early-exit while_loop runs the full MAX_NEW_TOKENS
     # steps and steps-executed == the cap (real models exit early and the
     # bytes model would overcount). Param width comes from the engine's own
     # resolved storage policy (f32 for sub-1B: measured faster).
-    step_bytes = decode_step_bytes(config, out.stats, engine.param_itemsize)
+    best = min(times)
+    profiles_per_sec = len(prompts) / best  # single chip: total == per-chip
+    tokens_per_sec = len(prompts) * MAX_NEW_TOKENS / best
+    sweep_stats = out.stats
+    step_bytes = decode_step_bytes(config, sweep_stats, engine.param_itemsize)
     achieved_gbps = step_bytes * MAX_NEW_TOKENS / best / 1e9
+
+    # Free the phase-1 engine (params + compiled big-batch caches) before the
+    # long-context engines spin up — at 1B/3B scale keeping it alive OOMs the
+    # auxiliary measurement.
+    del engine, out
+    phase2_listwise = None
+    try:
+        phase2_listwise = measure_phase2_listwise(config, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"phase2-listwise measurement skipped: {type(e).__name__}: {e}", file=sys.stderr)
 
     result = {
         "metric": f"phase1_sweep_decode_throughput[{model_name},{devices[0].platform}]",
@@ -163,7 +228,7 @@ def main() -> None:
             "decode_tokens_per_sec": round(tokens_per_sec, 1),
             "best_wall_s": round(best, 3),
             "all_wall_s": [round(t, 3) for t in times],
-            "decode_shape": out.stats,
+            "decode_shape": sweep_stats,
             "decode_bytes_per_step_mb": round(step_bytes / 1e6, 1),
             "achieved_hbm_gbps": round(achieved_gbps, 1),
             "pct_v5e_hbm_roofline": round(100 * achieved_gbps / V5E_HBM_GBPS, 1),
@@ -171,6 +236,7 @@ def main() -> None:
             "large_sweep_int8kv_profiles_per_sec": (
                 round(big_rate_int8, 3) if big_rate_int8 else None
             ),
+            "phase2_listwise": phase2_listwise,
             "baseline": "reference README: ~15 min for the 45-profile sweep via API",
         },
     }

@@ -179,6 +179,10 @@ def main(argv=None) -> int:
                                 args.num_items, args.num_comparisons, save=save,
                                 corpus=args.corpus, num_queries=args.num_queries)
                 print_phase2_summary(p2)
+                if save:
+                    from fairness_llm_tpu.reports import generate_phase2_figure
+
+                    generate_phase2_figure(p2, f"{config.results_dir}/visualizations")
             else:
                 p3 = run_phase3(config, phase1_results=p1, model_name=args.model,
                                 num_profiles=args.profiles, variant=args.variant,

@@ -2,8 +2,14 @@
 
 from fairness_llm_tpu.reports.figures import (
     generate_phase1_figures,
+    generate_phase2_figure,
     generate_phase3_figure,
     generate_summary_report,
 )
 
-__all__ = ["generate_phase1_figures", "generate_phase3_figure", "generate_summary_report"]
+__all__ = [
+    "generate_phase1_figures",
+    "generate_phase2_figure",
+    "generate_phase3_figure",
+    "generate_summary_report",
+]

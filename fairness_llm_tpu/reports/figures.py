@@ -96,6 +96,42 @@ def generate_phase1_figures(results: Dict, out_dir: str) -> List[str]:
     return written
 
 
+def generate_phase2_figure(results: Dict, out_dir: str) -> str:
+    """Per-model listwise/pairwise exposure-ratio bars + per-group exposure —
+    a phase-2 figure the reference's notebook never had."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(out_dir, exist_ok=True)
+    mf = results["comparison"]["model_fairness"]
+    models = list(mf.keys())
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4.5))
+    x = range(len(models))
+    w = 0.35
+    axes[0].bar([i - w / 2 for i in x], [mf[m]["listwise_fairness"] for m in models],
+                w, label="listwise", color="#2a9d8f")
+    axes[0].bar([i + w / 2 for i in x], [mf[m]["pairwise_fairness"] for m in models],
+                w, label="pairwise", color="#457b9d")
+    axes[0].set_xticks(list(x))
+    axes[0].set_xticklabels(models, rotation=15)
+    axes[0].axhline(_FAIR, ls="--", c="gray", lw=1)
+    axes[0].set_ylim(0, 1.05)
+    axes[0].set_title("Exposure ratio by model and method")
+    axes[0].legend()
+
+    # per-group exposure for the first model (means over queries)
+    first = results["model_results"][models[0]]["listwise"]["group_exposure"]
+    axes[1].bar(list(first.keys()), list(first.values()), color="#264653")
+    axes[1].set_title(f"Listwise group exposure — {models[0]}")
+    path = os.path.join(out_dir, "phase2_ranking_fairness.png")
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    logger.info("wrote %s", path)
+    return path
+
+
 def generate_phase3_figure(results: Dict, out_dir: str) -> str:
     """Before/after mitigation bars (fairness, bias, quality) — a figure the
     reference's notebook never had for phase 3."""

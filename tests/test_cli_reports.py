@@ -22,6 +22,7 @@ def test_cli_all_quick(tmp_path, capsys):
     assert os.path.exists(tmp_path / "phase1" / "phase1_summary_report.txt")
     assert os.path.exists(tmp_path / "visualizations" / "fairness_overview.png")
     assert os.path.exists(tmp_path / "visualizations" / "snsr_similarity.png")
+    assert os.path.exists(tmp_path / "visualizations" / "phase2_ranking_fairness.png")
 
 
 def test_cli_single_phase(tmp_path):

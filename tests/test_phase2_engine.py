@@ -1,0 +1,62 @@
+"""Phase 2 through the real decode engine at long-prompt scale: the listwise
+ranking batch is the framework's prefill-heavy headline path (bench.py
+``measure_phase2_listwise``); this covers it in the suite with the tiny model
+so engine/bucketing/flash-gating regressions surface off-TPU too."""
+
+import dataclasses
+
+import pytest
+
+from fairness_llm_tpu.config import Config, ModelSettings
+from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.pipeline.backends import EngineBackend
+from fairness_llm_tpu.pipeline.phase2 import (
+    evaluate_model,
+    listwise_evaluation_batch,
+    make_queries,
+)
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+
+@pytest.fixture(scope="module")
+def long_engine():
+    # tiny-test widened so a ~40-item byte-tokenized listwise prompt fits
+    config = dataclasses.replace(get_model_config("tiny-test"), max_seq_len=4096)
+    return DecodeEngine(config, seed=0)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    data = synthetic_movielens(num_movies=120, seed=9)
+    return movielens_ranking_corpus(data, num_items=40, seed=9, min_ratings=1)
+
+
+def test_listwise_long_prompt_batch_through_engine(long_engine, corpus):
+    backend = EngineBackend(long_engine, name="tiny-test")
+    settings = ModelSettings(temperature=0.7, max_tokens=16)
+    queries = make_queries(corpus, 3)
+    rankings, parsed = listwise_evaluation_batch(backend, corpus, queries, settings, seed=0)
+    assert len(rankings) == 3
+    ids = {it.id for it in corpus}
+    for r in rankings:
+        assert set(r) == ids  # identity fallback still yields full permutations
+    # the same prompts through the engine directly: decode shape confirms this
+    # really is the long-prompt path (bucketed prompt length > 1k tokens)
+    from fairness_llm_tpu.pipeline.prompts import listwise_prompt
+
+    out = long_engine.generate([listwise_prompt(corpus)], settings, seed=0)
+    assert out.stats["prompt_len"] > 1024
+
+
+def test_evaluate_model_through_engine_reports_failures(long_engine, corpus):
+    """Random-weight decode yields unparseable text; the failure report must
+    say so rather than silently producing identity metrics."""
+    backend = EngineBackend(long_engine, name="tiny-test")
+    settings = ModelSettings(temperature=0.7, max_tokens=16)
+    res = evaluate_model(backend, corpus, num_comparisons=4, settings=settings,
+                         seed=0, num_queries=2)
+    pf = res["parse_failures"]
+    assert 0.0 <= pf["listwise_failure_rate"] <= 1.0
+    assert "corpus_perplexity" in res  # engine-only extra
+    assert res["listwise"]["num_queries"] == 2
