@@ -110,8 +110,15 @@ class SimulatedRecommender:
     offset shrinks by ``mitigation`` (fair prompting "works"), letting phase 3
     demonstrate real bias reduction end to end.
 
-    Listwise prompts ("Your ranking:"): seeded permutation.
-    Pairwise prompts ("Your answer:"): seeded A/B choice.
+    Listwise prompts ("Your ranking:"): seeded permutation — biased when
+    ``catalog_groups`` is supplied: items of the preferred group get a score
+    boost proportional to ``bias``, so ranking-fairness metrics (exposure
+    ratio, per-group NDCG) measurably respond to the knob and two variants
+    with different bias levels give phase 2 a real cross-model comparison
+    (the reference compares gpt-3.5 vs gpt-4 the same way).
+    Pairwise prompts ("Your answer:"): seeded A/B choice, group-biased under
+    the same rule. ``bias`` is calibrated for [0, 1]: beyond 1 the pairwise
+    preference saturates (always prefers) while listwise keeps separating.
     """
 
     def __init__(
@@ -121,14 +128,33 @@ class SimulatedRecommender:
         bias: float = 0.6,
         mitigation: float = 0.85,
         name: str = "simulated",
+        catalog_groups: Optional[Sequence[str]] = None,
     ):
         if not catalog:
             raise ValueError("SimulatedRecommender needs a non-empty catalog")
+        if catalog_groups is not None and len(catalog_groups) != len(catalog):
+            raise ValueError("catalog_groups must align with catalog")
         self.catalog = list(catalog)
         self.seed = seed
         self.bias = bias
         self.mitigation = mitigation
         self.name = name
+        # Keyed on stripped title text (the ranking regexes strip whitespace);
+        # positional fallback in _rank covers duplicate/colliding titles.
+        self._groups = list(catalog_groups) if catalog_groups else []
+        self._group_of = {}
+        for text, group in zip(self.catalog, self._groups):
+            key = text.strip()
+            if key in self._group_of and self._group_of[key] != group:
+                logger.warning(
+                    "SimulatedRecommender: duplicate catalog title %r with "
+                    "conflicting groups; ranking bias uses positional mapping "
+                    "for full-catalog prompts", key,
+                )
+            self._group_of[key] = group
+        # The "preferred" group the biased ranker over-exposes: first group in
+        # sorted order — arbitrary but deterministic.
+        self._preferred = sorted(set(catalog_groups))[0] if catalog_groups else None
         order = sorted(
             range(len(self.catalog)), key=lambda i: _stable_hash(self.catalog[i], seed)
         )
@@ -151,16 +177,45 @@ class SimulatedRecommender:
         return "\n".join(f"{i + 1}. {t}" for i, t in enumerate(titles))
 
     def _rank(self, prompt: str, idx: int, seed: int) -> str:
-        num_items = len(re.findall(r"^\d+\.", prompt, flags=re.MULTILINE))
-        num_items = max(num_items, 1)
+        lines = re.findall(r"^\d+\.\s*(.+?)\s*$", prompt, flags=re.MULTILINE)
+        num_items = max(len(lines), 1)
         rng = np.random.default_rng([self.seed & 0x7FFFFFFF, seed & 0x7FFFFFFF, idx, 1])
-        perm = rng.permutation(num_items) + 1
-        return ",".join(str(int(p)) for p in perm)
+        if not self._group_of:  # group-blind: plain seeded permutation
+            perm = rng.permutation(num_items) + 1
+            return ",".join(str(int(p)) for p in perm)
+        # Group-biased ranking: preferred-group items float up by up to
+        # ``bias`` — exposure ratio degrades smoothly as bias grows. Group is
+        # looked up by title text; a full-catalog prompt (the listwise case:
+        # items enumerated in catalog order) falls back to positional mapping
+        # where text misses or duplicates collide.
+        positional_ok = len(lines) == len(self._groups)
+        scores = rng.random(num_items)
+        for i, text in enumerate(lines):
+            group = self._group_of.get(text)
+            if group is None and positional_ok:
+                group = self._groups[i]
+            if group == self._preferred:
+                scores[i] += self.bias
+        order = np.argsort(-scores, kind="stable") + 1
+        return ",".join(str(int(p)) for p in order)
 
     def _compare(self, prompt: str, idx: int, seed: int) -> str:
         rng = np.random.default_rng(
             [_stable_hash(prompt) & 0x7FFFFFFF, self.seed & 0x7FFFFFFF, seed & 0x7FFFFFFF]
         )
+        if self._group_of:
+            m = re.search(r"Document A:\s*(.+?)\s*\n+Document B:\s*(.+?)\s*\n", prompt)
+            if m:
+                ga = self._group_of.get(m.group(1))
+                gb = self._group_of.get(m.group(2))
+                if ga != gb and self._preferred in (ga, gb):
+                    # Prefer the preferred-group item with prob 0.5 + bias/2,
+                    # clamped: past bias=1 the pairwise preference saturates
+                    # at always-preferred while listwise keeps separating.
+                    p_pref = min(1.0, 0.5 + self.bias / 2)
+                    pick_pref = rng.random() < p_pref
+                    pref_is_a = ga == self._preferred
+                    return "A" if pick_pref == pref_is_a else "B"
         return "A" if rng.random() < 0.5 else "B"
 
     def generate(
@@ -195,23 +250,35 @@ class SimulatedRecommender:
         return out
 
 
+# Named simulated variants: distinct bias levels make cross-model phase-2
+# comparison non-vacuous without weights (e.g. --models simulated-fair
+# simulated-biased mirrors the reference's gpt-3.5 vs gpt-4 comparison).
+SIMULATED_VARIANTS = {"simulated": 0.6, "simulated-fair": 0.15, "simulated-biased": 0.9}
+
+
 def backend_for(
     model_name: str,
     config: Config,
     catalog: Optional[Sequence[str]] = None,
     params=None,
     allow_random: bool = False,
+    catalog_groups: Optional[Sequence[str]] = None,
 ) -> DecodeBackend:
     """Resolve a model name to a backend.
 
-    'simulated' -> SimulatedRecommender. A real model name builds a
-    DecodeEngine with HF weights from ``config.weights_dir/<model_name>``.
-    When no weights exist the call FAILS rather than silently sweeping with
-    randomly initialized weights and labeling the results with the model's
-    name — pass ``allow_random=True`` (smoke tests, benchmarks) to opt in.
+    'simulated' (or a ``SIMULATED_VARIANTS`` name) -> SimulatedRecommender.
+    A real model name builds a DecodeEngine with HF weights from
+    ``config.weights_dir/<model_name>``. When no weights exist the call FAILS
+    rather than silently sweeping with randomly initialized weights and
+    labeling the results with the model's name — pass ``allow_random=True``
+    (smoke tests, benchmarks) to opt in.
     """
-    if model_name == "simulated":
-        return SimulatedRecommender(catalog or [], seed=config.random_seed)
+    if model_name in SIMULATED_VARIANTS:
+        return SimulatedRecommender(
+            catalog or [], seed=config.random_seed,
+            bias=SIMULATED_VARIANTS[model_name], name=model_name,
+            catalog_groups=catalog_groups,
+        )
 
     import os
 

@@ -330,8 +330,11 @@ def run_phase2(
 
     model_results = {}
     known_settings = {n for n, _ in config.model_settings}
+    groups = [it.protected_attribute for it in items]
     for name in models:
-        backend = (backends or {}).get(name) or backend_for(name, config, catalog=catalog)
+        backend = (backends or {}).get(name) or backend_for(
+            name, config, catalog=catalog, catalog_groups=groups
+        )
         # Injected test doubles may carry names outside the settings table;
         # they take engine defaults, like the simulated backend.
         settings = config.settings_for(name) if name in known_settings else None

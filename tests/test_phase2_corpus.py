@@ -166,6 +166,43 @@ def test_parse_failures_surface_real_failures(tmp_path):
     assert pf["pairwise_unparsed_rate"] == 1.0
 
 
+def test_cross_model_comparison_detects_bias(tmp_path):
+    """The point of phase 2: models with different ranking-bias levels must
+    be distinguishable. simulated-fair vs simulated-biased on the same corpus
+    -> the biased variant scores a worse exposure ratio, and the preferred
+    group's exposure share grows with bias."""
+    config = Config(results_dir=str(tmp_path / "r"), data_dir="/nonexistent")
+    res = run_phase2(
+        config, models=["simulated-fair", "simulated-biased"], corpus="movielens",
+        num_items=80, num_queries=2, num_comparisons=30, save=False,
+    )
+    mf = res["comparison"]["model_fairness"]
+    fair_lw = mf["simulated-fair"]["listwise_fairness"]
+    biased_lw = mf["simulated-biased"]["listwise_fairness"]
+    assert biased_lw < fair_lw, (fair_lw, biased_lw)
+    assert mf["simulated-biased"]["average_fairness"] < mf["simulated-fair"]["average_fairness"]
+    # the biased ranker's pairwise preference ratio skews toward one group
+    pr = res["model_results"]["simulated-biased"]["pairwise"]["preference_ratio"]
+    assert max(pr.values()) - min(pr.values()) > 0.2
+
+
+def test_simulated_group_bias_is_monotone(tmp_path):
+    """Exposure ratio must degrade as the simulator's bias knob grows."""
+    from fairness_llm_tpu.pipeline.phase2 import evaluate_model
+
+    data = synthetic_movielens(num_movies=200, seed=7)
+    items = movielens_ranking_corpus(data, num_items=60, seed=7, min_ratings=1)
+    ers = []
+    for bias in (0.0, 0.5, 1.5):
+        backend = SimulatedRecommender(
+            [it.text for it in items], seed=3, bias=bias,
+            catalog_groups=[it.protected_attribute for it in items],
+        )
+        res = evaluate_model(backend, items, num_comparisons=10, seed=3)
+        ers.append(res["listwise"]["exposure_ratio"])
+    assert ers[0] > ers[1] > ers[2], ers
+
+
 def test_build_corpus_rejects_unknown(tmp_path):
     config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
     with pytest.raises(ValueError):
