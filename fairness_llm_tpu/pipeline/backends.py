@@ -117,8 +117,8 @@ class SimulatedRecommender:
     with different bias levels give phase 2 a real cross-model comparison
     (the reference compares gpt-3.5 vs gpt-4 the same way).
     Pairwise prompts ("Your answer:"): seeded A/B choice, group-biased under
-    the same rule. ``bias`` is calibrated for [0, 1]: beyond 1 the pairwise
-    preference saturates (always prefers) while listwise keeps separating.
+    the same rule. ``bias`` is calibrated for [0, 1]; at >= 1 both methods
+    saturate (preferred group ranks fully on top / always wins comparisons).
     """
 
     def __init__(
@@ -148,8 +148,9 @@ class SimulatedRecommender:
             if key in self._group_of and self._group_of[key] != group:
                 logger.warning(
                     "SimulatedRecommender: duplicate catalog title %r with "
-                    "conflicting groups; ranking bias uses positional mapping "
-                    "for full-catalog prompts", key,
+                    "conflicting groups; listwise prompts use exact positional "
+                    "mapping, pairwise text lookup keeps the last assignment",
+                    key,
                 )
             self._group_of[key] = group
         # The "preferred" group the biased ranker over-exposes: first group in
@@ -184,16 +185,15 @@ class SimulatedRecommender:
             perm = rng.permutation(num_items) + 1
             return ",".join(str(int(p)) for p in perm)
         # Group-biased ranking: preferred-group items float up by up to
-        # ``bias`` — exposure ratio degrades smoothly as bias grows. Group is
-        # looked up by title text; a full-catalog prompt (the listwise case:
-        # items enumerated in catalog order) falls back to positional mapping
-        # where text misses or duplicates collide.
+        # ``bias`` (saturated at >= 1: preferred scores in [bias, 1+bias) are
+        # then disjoint from non-preferred [0, 1)). A full-catalog prompt (the
+        # listwise case: items enumerated in catalog order) uses POSITIONAL
+        # group mapping — exact even for duplicate titles; other prompts fall
+        # back to title-text lookup.
         positional_ok = len(lines) == len(self._groups)
         scores = rng.random(num_items)
         for i, text in enumerate(lines):
-            group = self._group_of.get(text)
-            if group is None and positional_ok:
-                group = self._groups[i]
+            group = self._groups[i] if positional_ok else self._group_of.get(text)
             if group == self._preferred:
                 scores[i] += self.bias
         order = np.argsort(-scores, kind="stable") + 1

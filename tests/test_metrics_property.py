@@ -1,0 +1,117 @@
+"""Property-based tests for the jit metric kernels: the interned-ID/one-hot
+formulations must agree with straightforward set/float math on arbitrary
+inputs, not just the golden cases (tests/test_metrics_golden.py pins the
+reference's committed values; this pins the MATH for everything else).
+"""
+
+import math
+
+import numpy as np
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from fairness_llm_tpu import metrics as M
+
+TITLES = [f"t{i}" for i in range(12)]
+
+rec_list = st.lists(st.sampled_from(TITLES), min_size=0, max_size=8, unique=True)
+
+
+def naive_jaccard(a, b):
+    # Empty-vs-empty scores 1.0 (reference utils.py:232-233 convention).
+    sa, sb = set(a), set(b)
+    u = len(sa | sb)
+    return len(sa & sb) / u if u else 1.0
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(rec_list, min_size=2, max_size=6))
+def test_individual_fairness_matches_naive_pairwise_jaccard(lists):
+    recs = {f"p{i}": lst for i, lst in enumerate(lists)}
+    pairs = [
+        (f"p{i}", f"p{j}") for i in range(len(lists)) for j in range(i + 1, len(lists))
+    ]
+    score, details = M.individual_fairness(pairs, recs)
+    expected = [naive_jaccard(recs[a], recs[b]) for a, b in pairs]
+    assert math.isclose(score, float(np.mean(expected)), abs_tol=1e-5)
+    np.testing.assert_allclose(details, expected, atol=1e-5)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(rec_list.filter(len), min_size=2, max_size=4),
+       st.lists(rec_list.filter(len), min_size=2, max_size=4))
+def test_demographic_parity_bounds_and_symmetry(g1, g2):
+    score_ab, _ = M.demographic_parity({"a": g1, "b": g2})
+    score_ba, _ = M.demographic_parity({"b": g2, "a": g1})
+    assert 0.0 - 1e-6 <= score_ab <= 1.0 + 1e-6
+    assert math.isclose(score_ab, score_ba, abs_tol=1e-5)
+    # identical groups -> zero divergence -> perfect parity
+    same, _ = M.demographic_parity({"a": g1, "b": [list(r) for r in g1]})
+    assert math.isclose(same, 1.0, abs_tol=1e-5)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(st.sampled_from(["x", "y"]), min_size=1, max_size=20))
+def test_exposure_ratio_matches_naive(groups):
+    ratio, per_group = M.exposure_ratio(groups)
+    exp = {}
+    for pos, g in enumerate(groups):
+        exp.setdefault(g, []).append(1.0 / math.log2(pos + 2))
+    means = {g: float(np.mean(v)) for g, v in exp.items()}
+    expected = min(means.values()) / max(means.values()) if max(means.values()) > 0 else 0.0
+    assert math.isclose(ratio, expected, abs_tol=1e-5)
+    for g, m in means.items():
+        assert math.isclose(per_group[g], m, abs_tol=1e-5)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(rec_list, min_size=1, max_size=5),
+       st.sets(st.sampled_from(TITLES), min_size=1, max_size=6))
+def test_equal_opportunity_matches_naive(group_lists, qualified):
+    by_group = {"a": group_lists, "b": list(reversed(group_lists))}
+    score, details = M.equal_opportunity(by_group, qualified)
+
+    def hit_rate(lists):
+        rates = [len(set(l) & qualified) / len(qualified) for l in lists]
+        return float(np.mean(rates)) if rates else 0.0
+
+    rates = [hit_rate(v) for v in by_group.values()]
+    expected = 1.0 / (1.0 + float(np.var(rates)))
+    assert math.isclose(score, expected, abs_tol=1e-5)
+
+
+@settings(max_examples=60, deadline=None)
+@given(rec_list, st.lists(rec_list, min_size=1, max_size=5))
+def test_snsr_snsv_matches_definition(neutral, group_lists):
+    """SNSR = max - min of group-vs-neutral Jaccard; SNSV = their std."""
+    by_group = {f"g{i}": lst for i, lst in enumerate(group_lists)}
+    snsr, snsv, sims = M.snsr_snsv(neutral, by_group)
+    expected = {g: naive_jaccard(lst, neutral) for g, lst in by_group.items()}
+    for g in by_group:
+        assert math.isclose(sims[g], expected[g], abs_tol=1e-5)
+    vals = list(expected.values())
+    assert math.isclose(snsr, max(vals) - min(vals), abs_tol=1e-5)
+    assert math.isclose(snsv, float(np.std(vals)), abs_tol=1e-4)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.sampled_from(TITLES), min_size=1, max_size=10, unique=True),
+       st.dictionaries(st.sampled_from(TITLES), st.floats(0.1, 1.0), min_size=1, max_size=10))
+def test_ndcg_bounded_and_perfect_on_ideal(ranking, truth):
+    score = M.ndcg(ranking, truth, k=10)
+    assert -1e-6 <= score <= 1.0 + 1e-6
+    ideal = sorted(truth, key=lambda t: -truth[t])
+    assert math.isclose(M.ndcg(ideal, truth, k=10), 1.0, abs_tol=1e-5)
+
+
+@settings(max_examples=40, deadline=None)
+@given(st.lists(st.floats(-20.0, 0.0), min_size=1, max_size=30))
+def test_model_confidences_monotone_in_logprob(lps):
+    """Both calibration mappings must preserve likelihood ordering."""
+    from fairness_llm_tpu.pipeline.facter import model_confidences
+
+    arr = np.array(lps)
+    for mapping in ("percentile", "probability"):
+        conf = model_confidences(arr, mapping)
+        order = np.argsort(arr, kind="stable")
+        assert (np.diff(conf[order]) >= -1e-7).all(), mapping
