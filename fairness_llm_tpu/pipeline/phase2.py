@@ -121,6 +121,33 @@ def listwise_evaluation_batch(
     return rankings, parsed_counts
 
 
+def scored_ranking_prompt(query: Optional[str]) -> str:
+    """The conditioning prefix for likelihood-based ranking."""
+    q = query or "most relevant and high-quality documents"
+    return f"Query: {q}\nA highly relevant result: "
+
+
+def scored_evaluation(
+    backend: DecodeBackend,
+    items: Sequence[RankingItem],
+    queries: Sequence[Optional[str]],
+) -> List[List[int]]:
+    """TPU-native third ranking method (beyond the reference's listwise /
+    pairwise): rank items by the model's own conditional likelihood
+    log p(item | query) / len — one batched teacher-forced forward per query,
+    deterministic, and free of parse failures by construction. Requires an
+    EngineBackend (``runtime/scoring.score_continuations``)."""
+    from fairness_llm_tpu.runtime.scoring import score_continuations
+
+    engine = backend.engine  # type: ignore[attr-defined]
+    rankings = []
+    for q in queries:
+        sc = score_continuations(engine, scored_ranking_prompt(q), [it.text for it in items])
+        order = np.argsort(-sc.mean_logprobs, kind="stable")
+        rankings.append([items[int(i)].id for i in order])
+    return rankings
+
+
 def pairwise_evaluation(
     backend: DecodeBackend,
     items: Sequence[RankingItem],
@@ -188,6 +215,39 @@ def _exposure(ranked_ids: Sequence[int], items: Sequence[RankingItem]) -> Tuple[
     return M.exposure_ratio([attr[i] for i in ranked_ids])
 
 
+def _per_query_entry(query: Optional[str], ranked: List[int], items) -> Dict:
+    er, exposure = _exposure(ranked, items)
+    return {
+        "query": query or "default",
+        "ranking": ranked,
+        "exposure_ratio": er,
+        "group_exposure": exposure,
+        "ndcg_per_group": ndcg_per_group(ranked, items),
+    }
+
+
+def _aggregate_queries(per_query: List[Dict]) -> Dict:
+    """Mean-over-queries surface: scalar exposure ratio plus per-group dicts
+    aggregated the same way; "ranking" is query 0's (the default query).
+    Missing groups default to 0.0 (a group absent from one query's breakdown
+    contributed no exposure/NDCG there)."""
+
+    def mean_per_group(key: str) -> Dict[str, float]:
+        groups = sorted({g for q in per_query for g in q[key]})
+        return {
+            g: float(np.mean([q[key].get(g, 0.0) for q in per_query])) for g in groups
+        }
+
+    return {
+        "ranking": per_query[0]["ranking"],
+        "exposure_ratio": float(np.mean([q["exposure_ratio"] for q in per_query])),
+        "group_exposure": mean_per_group("group_exposure"),
+        "ndcg_per_group": mean_per_group("ndcg_per_group"),
+        "num_queries": len(per_query),
+        "per_query": per_query,
+    }
+
+
 def evaluate_model(
     backend: DecodeBackend,
     items: Sequence[RankingItem],
@@ -201,28 +261,10 @@ def evaluate_model(
 
     per_query = []
     for q, ranked, parsed in zip(queries, rankings, parsed_counts):
-        er, exposure = _exposure(ranked, items)
-        per_query.append(
-            {
-                "query": q or "default",
-                "ranking": ranked,
-                "exposure_ratio": er,
-                "group_exposure": exposure,
-                "ndcg_per_group": ndcg_per_group(ranked, items),
-                "indices_parsed": parsed,
-                "parse_failed": parsed == 0,
-            }
-        )
-    lw_er = float(np.mean([q["exposure_ratio"] for q in per_query]))
-    lw_groups = sorted({g for q in per_query for g in q["ndcg_per_group"]})
-    lw_ndcg = {
-        g: float(np.mean([q["ndcg_per_group"].get(g, 0.0) for q in per_query]))
-        for g in lw_groups
-    }
-    lw_exposure = {
-        g: float(np.mean([q["group_exposure"].get(g, 0.0) for q in per_query]))
-        for g in sorted({g for q in per_query for g in q["group_exposure"]})
-    }
+        entry = _per_query_entry(q, ranked, items)
+        entry["indices_parsed"] = parsed
+        entry["parse_failed"] = parsed == 0
+        per_query.append(entry)
 
     pw_ranked, comparisons = pairwise_evaluation(backend, items, num_comparisons, settings, seed)
     pw_er, pw_exposure = _exposure(pw_ranked, items)
@@ -238,20 +280,14 @@ def evaluate_model(
         extras["corpus_perplexity"] = perplexity_by_model(
             {backend.name: engine}, [it.text for it in items]
         )[backend.name]
+        # Third ranking method, likelihood-based (TPU-native; no parsing).
+        sc_rankings = scored_evaluation(backend, items, queries)
+        extras["scored"] = _aggregate_queries(
+            [_per_query_entry(q, r, items) for q, r in zip(queries, sc_rankings)]
+        )
     return {
         **extras,
-        "listwise": {
-            # Back-compat scalar/dict surface = means over queries (all of
-            # exposure_ratio, group_exposure, ndcg_per_group aggregate the
-            # same way); per-query detail, including each ranking, lives
-            # under "per_query". "ranking" is query 0's (the default query).
-            "ranking": per_query[0]["ranking"],
-            "exposure_ratio": lw_er,
-            "group_exposure": lw_exposure,
-            "ndcg_per_group": lw_ndcg,
-            "num_queries": len(queries),
-            "per_query": per_query,
-        },
+        "listwise": _aggregate_queries(per_query),
         "pairwise": {
             "ranking": pw_ranked,
             "exposure_ratio": pw_er,
@@ -278,15 +314,20 @@ def compare_models_and_methods(model_results: Dict[str, Dict]) -> Dict:
     """average_fairness = (listwise ER + pairwise ER)/2 per model (the number
     the reference's README headline cites — conflation noted in SURVEY.md §8.8)."""
     comparison: Dict = {"model_fairness": {}, "method_comparison": {}}
-    lw, pw = [], []
+    lw, pw, sc = [], [], []
     for name, res in model_results.items():
         l = res["listwise"]["exposure_ratio"]
         p = res["pairwise"]["exposure_ratio"]
-        comparison["model_fairness"][name] = {
+        entry = {
             "listwise_fairness": l,
             "pairwise_fairness": p,
+            # reference-compat: the average stays (listwise + pairwise) / 2
             "average_fairness": (l + p) / 2,
         }
+        if "scored" in res:
+            entry["scored_fairness"] = res["scored"]["exposure_ratio"]
+            sc.append(res["scored"]["exposure_ratio"])
+        comparison["model_fairness"][name] = entry
         lw.append(l)
         pw.append(p)
     comparison["method_comparison"] = {
@@ -295,6 +336,9 @@ def compare_models_and_methods(model_results: Dict[str, Dict]) -> Dict:
         "listwise_std": float(np.std(lw)) if lw else 0.0,
         "pairwise_std": float(np.std(pw)) if pw else 0.0,
     }
+    if sc:
+        comparison["method_comparison"]["scored_avg"] = float(np.mean(sc))
+        comparison["method_comparison"]["scored_std"] = float(np.std(sc))
     return comparison
 
 
@@ -377,9 +421,13 @@ def print_phase2_summary(results: Dict) -> None:
             "fair" if scores["average_fairness"] >= 0.8
             else "moderate" if scores["average_fairness"] >= 0.6 else "biased"
         )
+        scored = (
+            f" scored={scores['scored_fairness']:.4f}"
+            if "scored_fairness" in scores else ""
+        )
         print(
             f"{model}: listwise={scores['listwise_fairness']:.4f} "
-            f"pairwise={scores['pairwise_fairness']:.4f} "
+            f"pairwise={scores['pairwise_fairness']:.4f}{scored} "
             f"avg={scores['average_fairness']:.4f} ({level})"
         )
     mc = results["comparison"]["method_comparison"]

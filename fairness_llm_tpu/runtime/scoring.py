@@ -32,10 +32,14 @@ class ScoreOutput:
     mean_logprobs: np.ndarray  # [N] log_likelihood / token_count
 
 
-def score_texts(
-    engine: DecodeEngine, texts: Sequence[str], seed: int = 0
+def _score_batch(
+    engine: DecodeEngine, texts: Sequence[str], prefix_counts: np.ndarray
 ) -> ScoreOutput:
-    """Score each text's tokens under the engine's model (teacher-forced)."""
+    """Shared teacher-forced scoring scaffold (encode, left-truncate, bucket,
+    pad, jit-cache, mesh dispatch). ``prefix_counts[i]`` real tokens at the
+    start of row i are conditioning context: their logprobs are excluded.
+    ``score_texts`` is the prefix_counts=0 case; one compiled kernel serves
+    both (prefix_counts is a traced argument)."""
     tb = engine.tokenizer.encode_batch(texts)
     max_len = engine.config.max_seq_len
     if tb.tokens.shape[1] > max_len:
@@ -47,23 +51,32 @@ def score_texts(
         logging.getLogger(__name__).warning(
             "scoring texts longer than max_seq_len=%d; left-truncating", max_len
         )
+        # Left-truncation drops the EARLIEST tokens (prefix first): shrink
+        # each row's remaining-prefix count so the continuation boundary
+        # stays correct (positions restart at 0 within the kept window).
+        orig_lens = tb.valid.sum(axis=1)
         tb = engine.tokenizer.encode_batch(texts, max_len=max_len)
+        kept_lens = tb.valid.sum(axis=1)
+        dropped = np.maximum(orig_lens - kept_lens, 0)
+        prefix_counts = np.maximum(prefix_counts - dropped, 0)
     # Bucket with the engine's multiple so the forward stays flash-eligible.
     s = min(_bucket_len(tb.tokens.shape[1], engine.seq_bucket), max_len)
     n = len(texts)
     batch = _bucket_batch(n, engine.mesh)
     tokens = np.full((batch, s), engine.tokenizer.pad_id, dtype=np.int32)
     valid = np.zeros((batch, s), dtype=bool)
+    prefixes = np.zeros((batch,), dtype=np.int32)
     w = tb.tokens.shape[1]
     tokens[:n, s - w:] = tb.tokens
     valid[:n, s - w:] = tb.valid
+    prefixes[:n] = prefix_counts
 
     key = (batch, s, "score")
     fn = engine._compiled.get(key)
     if fn is None:
         model = engine.model
 
-        def run(params, tokens, valid):
+        def run(params, tokens, valid, prefixes):
             positions = jnp.maximum(jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0)
             # Forward over the FULL bucketed length (keeps seq a flash-eligible
             # multiple); the last position's logits predict nothing and drop.
@@ -73,6 +86,8 @@ def score_texts(
             logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
             targets = tokens[:, 1:]
             tvalid = valid[:, :-1] & valid[:, 1:]
+            # Score only targets whose real-token index is past the prefix.
+            tvalid = tvalid & (positions[:, 1:] >= prefixes[:, None])
             picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
             picked = jnp.where(tvalid, picked, 0.0)
             return jnp.sum(picked, axis=1), jnp.sum(tvalid, axis=1)
@@ -81,6 +96,7 @@ def score_texts(
         engine._compiled[key] = fn
 
     tokens_j, valid_j = jnp.asarray(tokens), jnp.asarray(valid)
+    prefixes_j = jnp.asarray(prefixes)
     if engine.mesh is not None:
         from fairness_llm_tpu.parallel import sharding as shd
 
@@ -88,9 +104,9 @@ def score_texts(
         tokens_j = jax.device_put(tokens_j, bs)
         valid_j = jax.device_put(valid_j, bs)
         with engine.mesh, nn.logical_axis_rules(engine.rules):
-            ll, counts = fn(engine.params, tokens_j, valid_j)
+            ll, counts = fn(engine.params, tokens_j, valid_j, prefixes_j)
     else:
-        ll, counts = fn(engine.params, tokens_j, valid_j)
+        ll, counts = fn(engine.params, tokens_j, valid_j, prefixes_j)
 
     ll = np.asarray(jax.device_get(ll))[:n]
     counts = np.asarray(jax.device_get(counts))[:n]
@@ -98,6 +114,37 @@ def score_texts(
         log_likelihoods=ll,
         token_counts=counts,
         mean_logprobs=np.where(counts > 0, ll / np.maximum(counts, 1), 0.0),
+    )
+
+
+def score_texts(
+    engine: DecodeEngine, texts: Sequence[str], seed: int = 0
+) -> ScoreOutput:
+    """Score each text's tokens under the engine's model (teacher-forced).
+    ``seed`` is accepted for signature stability; scoring is deterministic."""
+    return _score_batch(engine, texts, np.zeros(len(texts), dtype=np.int32))
+
+
+def score_continuations(
+    engine: DecodeEngine, prompt: str, continuations: Sequence[str]
+) -> ScoreOutput:
+    """Conditional scoring: log p(continuation | prompt) for each continuation.
+
+    All continuations share one prompt prefix and score as ONE batched
+    teacher-forced forward — the basis of phase 2's "scored" ranking method
+    (rank items by model likelihood instead of parsing a generated ranking;
+    no parse failures by construction). Only tokens whose real-token index is
+    >= the prompt's token count contribute, so by the chain rule
+    ``log p(prompt + c) = log p(prompt) + score_continuations(...)`` exactly
+    for tokenizers where concatenation composes token-wise (byte-level always;
+    BPE may merge across the boundary — then the split is approximate at the
+    first continuation token). Rows longer than max_seq_len left-truncate the
+    prefix first; the boundary shifts with it.
+    """
+    prefix_len = len(engine.tokenizer.encode(prompt))
+    texts = [prompt + c for c in continuations]
+    return _score_batch(
+        engine, texts, np.full(len(texts), prefix_len, dtype=np.int32)
     )
 
 

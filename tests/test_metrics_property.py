@@ -65,15 +65,21 @@ def test_exposure_ratio_matches_naive(groups):
 
 
 @settings(max_examples=60, deadline=None)
-@given(st.lists(rec_list, min_size=1, max_size=5),
+@given(st.lists(rec_list, min_size=1, max_size=4),
+       st.lists(rec_list, min_size=1, max_size=4),
        st.sets(st.sampled_from(TITLES), min_size=1, max_size=6))
-def test_equal_opportunity_matches_naive(group_lists, qualified):
-    by_group = {"a": group_lists, "b": list(reversed(group_lists))}
+def test_equal_opportunity_matches_naive(lists_a, lists_b, qualified):
+    """Kernel semantics: per group, hit rate = |unique recommended ∩ qualified|
+    / total recommended (duplicates count in the denominator only — the
+    reference's set-vs-len math); score = 1 / (1 + var(rates))."""
+    by_group = {"a": lists_a, "b": lists_b}
     score, details = M.equal_opportunity(by_group, qualified)
 
     def hit_rate(lists):
-        rates = [len(set(l) & qualified) / len(qualified) for l in lists]
-        return float(np.mean(rates)) if rates else 0.0
+        flat = [t for l in lists for t in l]
+        if not flat:
+            return 0.0
+        return len(set(flat) & qualified) / len(flat)
 
     rates = [hit_rate(v) for v in by_group.values()]
     expected = 1.0 / (1.0 + float(np.var(rates)))

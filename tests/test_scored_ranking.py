@@ -1,0 +1,110 @@
+"""Likelihood-based ("scored") phase-2 ranking: the TPU-native third method.
+
+Core contract: ``score_continuations`` must satisfy the chain rule exactly
+for the byte tokenizer — log p(prompt + c) = log p(prompt) + log p(c | prompt)
+— so the ranking reflects true conditional likelihood, not an approximation.
+"""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import Config, ModelSettings
+from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.pipeline.backends import EngineBackend
+from fairness_llm_tpu.pipeline.phase2 import (
+    evaluate_model,
+    make_queries,
+    scored_evaluation,
+)
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.runtime.scoring import score_continuations, score_texts
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    data = synthetic_movielens(num_movies=80, seed=4)
+    return movielens_ranking_corpus(data, num_items=12, seed=4, min_ratings=1)
+
+
+def test_chain_rule_decomposition(engine):
+    """Conditional + prefix likelihood == full-text likelihood, per row."""
+    prompt = "Query: best films\nA highly relevant result: "
+    conts = ["Alpha Movie (1990)", "A Much Longer Movie Title (2001)", "Z"]
+    full = score_texts(engine, [prompt + c for c in conts])
+    prefix = score_texts(engine, [prompt])
+    cond = score_continuations(engine, prompt, conts)
+    for i in range(len(conts)):
+        np.testing.assert_allclose(
+            cond.log_likelihoods[i] + prefix.log_likelihoods[0],
+            full.log_likelihoods[i],
+            atol=5e-3,  # f32 log-softmax re-accumulation across two forwards
+        )
+    # token accounting: continuation tokens only
+    assert (cond.token_counts == full.token_counts - prefix.token_counts[0]).all()
+
+
+def test_truncated_row_boundary_accounting(engine):
+    """A row longer than max_seq_len left-truncates the PREFIX first: the
+    scored-token count must be kept_len - remaining_prefix, and untruncated
+    rows in the same batch stay fully scored (the boundary filter previously
+    dropped the first prefix_len continuation tokens of truncated rows)."""
+    max_len = engine.config.max_seq_len  # tiny-test: 256 (byte tokenizer)
+    prompt = "Q" * 40 + ": "
+    prefix_len = len(engine.tokenizer.encode(prompt))
+    short, long = "ok", "x" * (max_len + 50)
+    out = score_continuations(engine, prompt, [short, long])
+
+    short_total = len(engine.tokenizer.encode(prompt + short))
+    assert out.token_counts[0] == short_total - prefix_len  # untruncated: exact
+
+    long_total = len(engine.tokenizer.encode(prompt + long))
+    kept = min(long_total, max_len)
+    dropped = long_total - kept
+    remaining_prefix = max(prefix_len - dropped, 0)  # 0 here: prefix fully cut
+    assert remaining_prefix == 0
+    assert out.token_counts[1] == kept - remaining_prefix - 1  # -1: first kept
+    # token has no predecessor to be predicted from (target-shift)
+
+
+def test_scored_evaluation_full_permutation_and_determinism(engine, corpus):
+    backend = EngineBackend(engine, name="tiny-test")
+    queries = make_queries(corpus, 2)
+    r1 = scored_evaluation(backend, corpus, queries)
+    r2 = scored_evaluation(backend, corpus, queries)
+    assert r1 == r2  # deterministic: no sampling anywhere
+    ids = {it.id for it in corpus}
+    for r in r1:
+        assert set(r) == ids
+
+
+def test_evaluate_model_includes_scored_method(engine, corpus):
+    backend = EngineBackend(engine, name="tiny-test")
+    settings = ModelSettings(temperature=0.7, max_tokens=16)
+    res = evaluate_model(backend, corpus, num_comparisons=4, settings=settings,
+                         seed=0, num_queries=2)
+    sc = res["scored"]
+    assert sc["num_queries"] == 2 and len(sc["per_query"]) == 2
+    assert 0.0 < sc["exposure_ratio"] <= 1.0
+    assert set(sc["ranking"]) == {it.id for it in corpus}
+
+
+def test_comparison_includes_scored_fairness(engine, corpus, tmp_path):
+    from fairness_llm_tpu.pipeline.phase2 import compare_models_and_methods
+
+    backend = EngineBackend(engine, name="tiny-test")
+    settings = ModelSettings(temperature=0.7, max_tokens=16)
+    res = evaluate_model(backend, corpus, num_comparisons=4, settings=settings, seed=0)
+    comp = compare_models_and_methods({"tiny-test": res})
+    mf = comp["model_fairness"]["tiny-test"]
+    assert "scored_fairness" in mf
+    # reference-compat average remains (listwise + pairwise) / 2
+    assert mf["average_fairness"] == pytest.approx(
+        (mf["listwise_fairness"] + mf["pairwise_fairness"]) / 2
+    )
+    assert "scored_avg" in comp["method_comparison"]
