@@ -78,7 +78,9 @@ def build_sweep_prompts():
 def build_listwise_prompts(num_items: int = 60, num_queries: int = 4):
     """Phase-2 at scale: long listwise ranking prompts (hundreds of items),
     several queries decoded as one batch — the prefill-heavy counterpart to
-    the decode-heavy phase-1 sweep."""
+    the decode-heavy phase-1 sweep. Returns (prompts, items, queries) so the
+    scored measurement reuses the SAME corpus and query set (the
+    vs_listwise_decode ratio depends on that identity)."""
     from fairness_llm_tpu.config import default_config
     from fairness_llm_tpu.data import load_movielens, movielens_ranking_corpus
     from fairness_llm_tpu.pipeline.phase2 import make_queries
@@ -88,7 +90,7 @@ def build_listwise_prompts(num_items: int = 60, num_queries: int = 4):
     data = load_movielens(config.data_dir, seed=config.random_seed)
     items = movielens_ranking_corpus(data, num_items, seed=config.random_seed, min_ratings=1)
     queries = make_queries(items, num_queries)
-    return [listwise_prompt(items, query=q) for q in queries], len(items)
+    return [listwise_prompt(items, query=q) for q in queries], items, queries
 
 
 def measure_phase2_listwise(config, settings_cls) -> dict | None:
@@ -106,12 +108,19 @@ def measure_phase2_listwise(config, settings_cls) -> dict | None:
 
     from fairness_llm_tpu.runtime.engine import DecodeEngine
 
-    prompts, num_items = build_listwise_prompts()
+    prompts, items, queries = build_listwise_prompts()
+    num_items = len(items)
     long_cfg = dataclasses.replace(config, max_seq_len=4096, kv_cache_quant=False)
     settings = settings_cls(temperature=0.7, top_k=0, top_p=1.0, max_tokens=32)
 
     out = {}
-    for label, flash in (("flash", True), ("dense", False)):
+    # Dense first so the flash engine survives the loop: the scored
+    # measurement below reuses it rather than compiling a third engine
+    # (which pushed the whole bench past its time budget).
+    eng = None
+    for label, flash in (("dense", False), ("flash", True)):
+        if eng is not None:
+            del eng
         eng = DecodeEngine(
             dataclasses.replace(long_cfg, use_flash_attention=flash), seed=0
         )
@@ -125,10 +134,28 @@ def measure_phase2_listwise(config, settings_cls) -> dict | None:
             "queries_per_sec": round(len(prompts) / wall, 3),
             "decode_shape": res.stats,
         }
-        del eng
     out["num_items"] = num_items
     out["num_queries"] = len(prompts)
     out["flash_speedup"] = round(out["dense"]["wall_s"] / out["flash"]["wall_s"], 3)
+
+    # Likelihood-scored ranking over the SAME corpus and queries: all
+    # (query, item) pairs score as chunked teacher-forced forwards (no
+    # autoregressive decode, no parsing).
+    from fairness_llm_tpu.pipeline.backends import EngineBackend
+    from fairness_llm_tpu.pipeline.phase2 import scored_evaluation
+
+    backend = EngineBackend(eng, name="bench")
+    scored_evaluation(backend, items, queries)  # warmup/compile
+    t0 = time.perf_counter()
+    scored_evaluation(backend, items, queries)
+    wall = time.perf_counter() - t0
+    out["scored"] = {
+        "wall_s": round(wall, 3),
+        "queries_per_sec": round(len(queries) / wall, 3),
+        # same query count as the listwise measurement -> direct wall ratio
+        "vs_listwise_decode": round(out["flash"]["wall_s"] / max(wall, 1e-9), 2),
+    }
+    del eng
     return out
 
 

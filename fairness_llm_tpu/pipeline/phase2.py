@@ -134,16 +134,21 @@ def scored_evaluation(
 ) -> List[List[int]]:
     """TPU-native third ranking method (beyond the reference's listwise /
     pairwise): rank items by the model's own conditional likelihood
-    log p(item | query) / len — one batched teacher-forced forward per query,
+    log p(item | query) / len — ALL (query, item) pairs score as one batched
+    teacher-forced forward (params stream once, not once per query),
     deterministic, and free of parse failures by construction. Requires an
-    EngineBackend (``runtime/scoring.score_continuations``)."""
-    from fairness_llm_tpu.runtime.scoring import score_continuations
+    EngineBackend (``runtime/scoring.score_prompted_continuations``)."""
+    from fairness_llm_tpu.runtime.scoring import score_prompted_continuations
 
     engine = backend.engine  # type: ignore[attr-defined]
+    n = len(items)
+    row_prompts = [scored_ranking_prompt(q) for q in queries for _ in items]
+    row_conts = [it.text for _ in queries for it in items]
+    sc = score_prompted_continuations(engine, row_prompts, row_conts)
+    per_query_scores = sc.mean_logprobs.reshape(len(queries), n)
     rankings = []
-    for q in queries:
-        sc = score_continuations(engine, scored_ranking_prompt(q), [it.text for it in items])
-        order = np.argsort(-sc.mean_logprobs, kind="stable")
+    for qi in range(len(queries)):
+        order = np.argsort(-per_query_scores[qi], kind="stable")
         rankings.append([items[int(i)].id for i in order])
     return rankings
 

@@ -36,7 +36,7 @@ import flax.linen as nn
 from fairness_llm_tpu.config import MeshConfig, ModelSettings
 from fairness_llm_tpu.models.configs import ModelConfig
 from fairness_llm_tpu.models.tokenizer import tokenizer_for
-from fairness_llm_tpu.models.transformer import Transformer, init_cache, init_params
+from fairness_llm_tpu.models.transformer import Transformer, init_cache
 from fairness_llm_tpu.parallel import sharding as shd
 from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
 

@@ -24,6 +24,11 @@ import numpy as np
 
 from fairness_llm_tpu.runtime.engine import DecodeEngine, _bucket_batch, _bucket_len
 
+# Cap on the [batch, s, vocab] f32 logits tensor one scoring forward may
+# materialize; larger sweeps halve-and-recurse (module-level so tests can
+# shrink it to exercise the chunked path with tiny models).
+LOGITS_BUDGET_BYTES = 4e9
+
 
 @dataclasses.dataclass
 class ScoreOutput:
@@ -59,16 +64,47 @@ def _score_batch(
         kept_lens = tb.valid.sum(axis=1)
         dropped = np.maximum(orig_lens - kept_lens, 0)
         prefix_counts = np.maximum(prefix_counts - dropped, 0)
-    # Bucket with the engine's multiple so the forward stays flash-eligible.
-    s = min(_bucket_len(tb.tokens.shape[1], engine.seq_bucket), max_len)
-    n = len(texts)
+    # Encoded + truncation-adjusted exactly once; chunking happens downstream
+    # on the encoded rows (re-running this function on raw texts would apply
+    # the prefix adjustment twice).
+    return _score_encoded(engine, tb.tokens, tb.valid, np.asarray(prefix_counts))
+
+
+def _score_encoded(
+    engine: DecodeEngine, row_tokens: np.ndarray, row_valid: np.ndarray,
+    prefix_counts: np.ndarray,
+) -> ScoreOutput:
+    """Forward + reduce over already-encoded rows, chunking for memory."""
+    n = len(row_tokens)
+    # Trim fully-pad leading columns (rows are left-padded) so a chunk of
+    # short rows buckets to its own tight length.
+    lead = int(np.argmax(row_valid.any(axis=0))) if row_valid.any() else 0
+    row_tokens, row_valid = row_tokens[:, lead:], row_valid[:, lead:]
+    max_len = engine.config.max_seq_len
+    s = min(_bucket_len(max(row_tokens.shape[1], 1), engine.seq_bucket), max_len)
     batch = _bucket_batch(n, engine.mesh)
+
+    # The forward materializes [batch, s, vocab] logits; cap that tensor so a
+    # large scoring sweep (e.g. every (query, item) pair of phase 2's scored
+    # ranking) chunks into several forwards instead of OOMing HBM. Per-device
+    # budget ~4 GB of f32 logits leaves room for params + activations on a
+    # 16 GB chip; halve-and-recurse keeps each chunk's own bucketing.
+    logits_bytes = batch * s * engine.config.vocab_size * 4
+    if logits_bytes > LOGITS_BUDGET_BYTES and n > 8:
+        half = n // 2
+        a = _score_encoded(engine, row_tokens[:half], row_valid[:half], prefix_counts[:half])
+        b = _score_encoded(engine, row_tokens[half:], row_valid[half:], prefix_counts[half:])
+        return ScoreOutput(
+            log_likelihoods=np.concatenate([a.log_likelihoods, b.log_likelihoods]),
+            token_counts=np.concatenate([a.token_counts, b.token_counts]),
+            mean_logprobs=np.concatenate([a.mean_logprobs, b.mean_logprobs]),
+        )
     tokens = np.full((batch, s), engine.tokenizer.pad_id, dtype=np.int32)
     valid = np.zeros((batch, s), dtype=bool)
     prefixes = np.zeros((batch,), dtype=np.int32)
-    w = tb.tokens.shape[1]
-    tokens[:n, s - w:] = tb.tokens
-    valid[:n, s - w:] = tb.valid
+    w = row_tokens.shape[1]
+    tokens[:n, s - w:] = row_tokens
+    valid[:n, s - w:] = row_valid
     prefixes[:n] = prefix_counts
 
     key = (batch, s, "score")
@@ -83,13 +119,16 @@ def _score_batch(
             logits, _ = model.apply(
                 {"params": params}, tokens, positions, valid, left_padded=True
             )
-            logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+            lg = logits[:, :-1]
             targets = tokens[:, 1:]
             tvalid = valid[:, :-1] & valid[:, 1:]
             # Score only targets whose real-token index is past the prefix.
             tvalid = tvalid & (positions[:, 1:] >= prefixes[:, None])
-            picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-            picked = jnp.where(tvalid, picked, 0.0)
+            # Gather-then-logsumexp instead of materializing a full [B, S, V]
+            # log_softmax temp alongside the logits.
+            picked_logit = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0]
+            lse = jax.scipy.special.logsumexp(lg, axis=-1)
+            picked = jnp.where(tvalid, picked_logit - lse, 0.0)
             return jnp.sum(picked, axis=1), jnp.sum(tvalid, axis=1)
 
         fn = jax.jit(run)
@@ -123,6 +162,23 @@ def score_texts(
     """Score each text's tokens under the engine's model (teacher-forced).
     ``seed`` is accepted for signature stability; scoring is deterministic."""
     return _score_batch(engine, texts, np.zeros(len(texts), dtype=np.int32))
+
+
+def score_prompted_continuations(
+    engine: DecodeEngine, prompts: Sequence[str], continuations: Sequence[str]
+) -> ScoreOutput:
+    """Per-row conditional scoring: row i scores log p(continuations[i] |
+    prompts[i]). Generalizes ``score_continuations`` to many prompts in ONE
+    batched forward — e.g. phase 2 scores every (query, item) pair of a
+    multi-query ranking sweep as a single device program instead of one
+    param-streaming dispatch per query."""
+    if len(prompts) != len(continuations):
+        raise ValueError("prompts and continuations must align")
+    prefix_counts = np.array(
+        [len(engine.tokenizer.encode(p)) for p in prompts], dtype=np.int32
+    )
+    texts = [p + c for p, c in zip(prompts, continuations)]
+    return _score_batch(engine, texts, prefix_counts)
 
 
 def score_continuations(

@@ -26,7 +26,6 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fairness_llm_tpu.models.configs import ModelConfig
 from fairness_llm_tpu.parallel import sharding as shd

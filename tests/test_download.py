@@ -1,7 +1,6 @@
 """data/download.py: extract+verify logic against a fabricated local archive
 (no network — the fetch path is exercised via a file:// URL)."""
 
-import os
 import zipfile
 
 from fairness_llm_tpu.data.download import EXPECTED_ROWS, fetch_ml1m

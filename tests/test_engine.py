@@ -6,7 +6,6 @@ Replaces the verification the reference never had for its inference layer
 """
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

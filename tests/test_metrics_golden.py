@@ -12,7 +12,6 @@ float64 numpy. Observed deltas are ~5e-6, so 1e-4 absolute keeps us four
 decimal places of agreement — far inside BASELINE.md's ±1% fidelity bar.
 """
 
-import numpy as np
 import pytest
 
 from fairness_llm_tpu.data.profiles import Profile, profile_pairs

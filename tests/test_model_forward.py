@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from fairness_llm_tpu.models import Transformer, get_model_config, init_params
-from fairness_llm_tpu.models.configs import MODEL_CONFIGS, ModelConfig
+from fairness_llm_tpu.models.configs import MODEL_CONFIGS
 from fairness_llm_tpu.models.transformer import init_cache
 
 

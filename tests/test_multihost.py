@@ -1,6 +1,5 @@
 """Multi-host helpers: single-process degradation + mesh layout invariants."""
 
-import jax
 import numpy as np
 import pytest
 

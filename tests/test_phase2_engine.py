@@ -7,7 +7,7 @@ import dataclasses
 
 import pytest
 
-from fairness_llm_tpu.config import Config, ModelSettings
+from fairness_llm_tpu.config import ModelSettings
 from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
 from fairness_llm_tpu.models.configs import get_model_config
 from fairness_llm_tpu.pipeline.backends import EngineBackend

@@ -8,7 +8,7 @@ for the byte tokenizer — log p(prompt + c) = log p(prompt) + log p(c | prompt)
 import numpy as np
 import pytest
 
-from fairness_llm_tpu.config import Config, ModelSettings
+from fairness_llm_tpu.config import ModelSettings
 from fairness_llm_tpu.data import movielens_ranking_corpus, synthetic_movielens
 from fairness_llm_tpu.models.configs import get_model_config
 from fairness_llm_tpu.pipeline.backends import EngineBackend
@@ -70,6 +70,26 @@ def test_truncated_row_boundary_accounting(engine):
     assert remaining_prefix == 0
     assert out.token_counts[1] == kept - remaining_prefix - 1  # -1: first kept
     # token has no predecessor to be predicted from (target-shift)
+
+
+def test_chunked_scoring_matches_unchunked(engine, monkeypatch):
+    """The memory chunker must not change values — including when rows are
+    ALSO truncated (the prefix adjustment once double-applied per recursion
+    level, scoring surviving prompt tokens as continuation)."""
+    import fairness_llm_tpu.runtime.scoring as scoring
+
+    max_len = engine.config.max_seq_len
+    prompt = "P" * 30 + ": "
+    conts = [f"doc {i} " + "y" * (20 * i) for i in range(12)]
+    conts.append("z" * (max_len + 40))  # forces left-truncation of its row
+    baseline = score_continuations(engine, prompt, conts)
+
+    monkeypatch.setattr(scoring, "LOGITS_BUDGET_BYTES", 1.0)  # chunk maximally
+    chunked = score_continuations(engine, prompt, conts)
+    np.testing.assert_allclose(
+        chunked.log_likelihoods, baseline.log_likelihoods, atol=5e-3
+    )
+    assert (chunked.token_counts == baseline.token_counts).all()
 
 
 def test_scored_evaluation_full_permutation_and_determinism(engine, corpus):

@@ -1,6 +1,5 @@
 """Sequence scoring + dp-sharded on-device metric reduction."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

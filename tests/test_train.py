@@ -1,12 +1,10 @@
 """Training-step tests: loss decreases, sharded step runs on the 8-device mesh,
 remat matches non-remat numerics."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from fairness_llm_tpu.models.configs import get_model_config
 from fairness_llm_tpu.train import make_train_step

@@ -2,7 +2,6 @@
 (save HF layout -> resolve -> load -> decode) plus the no-weights refusal,
 and the reference-parity measure_* wrappers + RateLimiter."""
 
-import dataclasses
 import time
 
 import jax
