@@ -92,9 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="phase-3 mitigation variant")
     p.add_argument("--strategy", default="demographic_parity",
                    choices=("demographic_parity", "equal_opportunity", "individual_fairness"))
-    p.add_argument("--calibration", default="simulated", choices=("simulated", "model"),
+    p.add_argument("--calibration", default="simulated", choices=("simulated", "model", "model-conditional"),
                    help="phase-3 conformal confidences: reference-style simulated "
-                        "curve, or the model's own title likelihoods")
+                        "curve, the model's own unconditional title likelihoods, or "
+                        "likelihoods conditioned on the profile's watch history "
+                        "(model-conditional; demographics excluded from the context)")
     p.add_argument("--confidence-mapping", default="percentile",
                    choices=("percentile", "probability"),
                    help="with --calibration model: how likelihoods map onto the "

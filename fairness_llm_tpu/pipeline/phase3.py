@@ -86,12 +86,22 @@ def apply_facter(
 
     ``calibration``: "simulated" reproduces the reference's rank-decreasing
     confidence curve (``1 - 0.05*rank``); "model" derives each item's
-    confidence from the backend model's own likelihood of the title
-    (``runtime/scoring.py``) — requires an EngineBackend.
+    confidence from the backend model's own UNCONDITIONAL likelihood of the
+    title; "model-conditional" from the likelihood of the title GIVEN the
+    profile's watch history (``prompts.calibration_context`` — demographics
+    deliberately excluded from the conditioning, so confidence reflects taste
+    fit, not protected attributes). Both model modes need an EngineBackend.
     ``confidence_mapping``: how model likelihoods land on the conformal
     confidence scale — see ``facter.model_confidences`` for the semantics of
     "percentile" (rank-normalized, default) vs "probability"
     (temperature-scaled by ``confidence_temperature``)."""
+    if calibration not in ("simulated", "model", "model-conditional"):
+        # An unrecognized string would silently run the simulated curve while
+        # the results metadata records the requested name — refuse instead.
+        raise ValueError(
+            f"unknown calibration {calibration!r} "
+            "(simulated | model | model-conditional)"
+        )
     anonymize = variant in ("smart", "aggressive")
     prompts = [
         fairness_aware_prompt(
@@ -124,23 +134,39 @@ def apply_facter(
     gender_of = {p.id: p.gender for p in profiles}
     lengths = np.array([len(fair_lists[pid]) for pid in pids], dtype=np.int64)
 
-    if calibration == "model":
+    if calibration in ("model", "model-conditional"):
         engine = getattr(backend, "engine", None)
         if engine is None:
-            raise ValueError("calibration='model' needs an EngineBackend")
-        from fairness_llm_tpu.runtime.scoring import score_texts
+            raise ValueError(f"calibration={calibration!r} needs an EngineBackend")
 
         all_titles = [t for pid in pids for t in fair_lists[pid]]
-        unique_titles = sorted(set(all_titles))
-        if unique_titles:
+        if not all_titles:
+            lp_flat = np.zeros(0, np.float64)
+        elif calibration == "model":
+            # Unconditional: one score per unique title, broadcast.
+            from fairness_llm_tpu.runtime.scoring import score_texts
+
+            unique_titles = sorted(set(all_titles))
             sc = score_texts(engine, unique_titles)
             lp_of = dict(zip(unique_titles, sc.mean_logprobs))
             lp_flat = np.array([lp_of[t] for t in all_titles], np.float64)
-            conf = model_confidences(
-                lp_flat, mapping=confidence_mapping, temperature=confidence_temperature
-            )
         else:
-            conf = np.zeros(0, np.float32)
+            # Conditional: log p(title | user's watch history) per (profile,
+            # title) row, one chunked batched forward for the whole sweep.
+            from fairness_llm_tpu.pipeline.prompts import calibration_context
+            from fairness_llm_tpu.runtime.scoring import score_prompted_continuations
+
+            prof_of = {p.id: p for p in profiles}
+            ctx = [
+                calibration_context(prof_of[pid])
+                for pid in pids
+                for _ in fair_lists[pid]
+            ]
+            sc = score_prompted_continuations(engine, ctx, all_titles)
+            lp_flat = np.asarray(sc.mean_logprobs, np.float64)
+        conf = model_confidences(
+            lp_flat, mapping=confidence_mapping, temperature=confidence_temperature
+        )
         conf_rows = np.split(conf, np.cumsum(lengths)[:-1]) if len(pids) else []
         nonconf = nonconformity_from_confidence(conf, config.random_seed)
     else:
@@ -157,7 +183,7 @@ def apply_facter(
     )
     per_profile_thresh = np.array([thresholds[gidx[gender_of[pid]]] for pid in pids])
 
-    if calibration == "model":
+    if calibration in ("model", "model-conditional"):
         k_max = int(lengths.max()) if len(lengths) else 1
         conf_mat = np.full((len(pids), max(k_max, 1)), np.nan, np.float32)
         for i, row in enumerate(conf_rows):
@@ -246,11 +272,11 @@ def run_phase3(
 ) -> Dict:
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}")
-    if calibration == "model" and variant != "conformal":
+    if calibration != "simulated" and variant != "conformal":
         # smart/aggressive re-rank without conformal filtering, so model
         # calibration would be silently ignored — refuse instead of
         # misrecording it in the results metadata.
-        raise ValueError("calibration='model' applies only to variant='conformal'")
+        raise ValueError("model calibration applies only to variant='conformal'")
     config = config or default_config()
     model_name = model_name or config.default_model_phase3
     t0 = time.time()
@@ -327,7 +353,7 @@ def run_phase3(
             "variant": variant,
             "strategy": strategy,
             "calibration": calibration,
-            "confidence_mapping": confidence_mapping if calibration == "model" else None,
+            "confidence_mapping": confidence_mapping if calibration != "simulated" else None,
             "model": backend.name,
             "num_profiles": len(profiles),
             "timestamp": time.time(),
@@ -374,7 +400,7 @@ if __name__ == "__main__":  # standalone entry (reference phase files are execut
     ap.add_argument("--profiles", type=int, default=None)
     ap.add_argument("--variant", default="conformal", choices=VARIANTS)
     ap.add_argument("--strategy", default="demographic_parity")
-    ap.add_argument("--calibration", default="simulated", choices=("simulated", "model"))
+    ap.add_argument("--calibration", default="simulated", choices=("simulated", "model", "model-conditional"))
     ap.add_argument("--confidence-mapping", default="percentile",
                     choices=("percentile", "probability"))
     ap.add_argument("--confidence-temperature", type=float, default=1.0)

@@ -118,6 +118,16 @@ def fairness_aware_prompt(
     return f"{FAIR_SYSTEM}\n\n{instruction}\n\n{base_prompt}"
 
 
+def calibration_context(profile: Profile, num_movies: int = 5) -> str:
+    """Conditioning prefix for phase-3 conditional model calibration: the
+    model's likelihood of a recommended title GIVEN this user's taste (vs the
+    unconditional title likelihood of ``calibration="model"``). Deliberately
+    short — watch history only, no demographics, so confidence never
+    conditions on protected attributes."""
+    movies = ", ".join(profile.watched_movies[:num_movies])
+    return f"A user who enjoyed {movies} would also enjoy: "
+
+
 def listwise_prompt(items: Sequence[RankingItem], query: Optional[str] = None) -> str:
     query = query or "most relevant and high-quality documents"
     lines = "\n".join(f"{i + 1}. {item.text}" for i, item in enumerate(items))

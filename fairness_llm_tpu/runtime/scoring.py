@@ -86,10 +86,11 @@ def _score_encoded(
 
     # The forward materializes [batch, s, vocab] logits; cap that tensor so a
     # large scoring sweep (e.g. every (query, item) pair of phase 2's scored
-    # ranking) chunks into several forwards instead of OOMing HBM. Per-device
-    # budget ~4 GB of f32 logits leaves room for params + activations on a
-    # 16 GB chip; halve-and-recurse keeps each chunk's own bucketing.
-    logits_bytes = batch * s * engine.config.vocab_size * 4
+    # ranking) chunks into several forwards instead of OOMing HBM. The budget
+    # is PER DEVICE (~4 GB of f32 logits leaves room for params + activations
+    # on a 16 GB chip); the batch axis shards over dp, so divide by it.
+    dp = engine.mesh.shape.get("dp", 1) if engine.mesh is not None else 1
+    logits_bytes = batch * s * engine.config.vocab_size * 4 // dp
     if logits_bytes > LOGITS_BUDGET_BYTES and n > 8:
         half = n // 2
         a = _score_encoded(engine, row_tokens[:half], row_valid[:half], prefix_counts[:half])
@@ -174,9 +175,13 @@ def score_prompted_continuations(
     param-streaming dispatch per query."""
     if len(prompts) != len(continuations):
         raise ValueError("prompts and continuations must align")
-    prefix_counts = np.array(
-        [len(engine.tokenizer.encode(p)) for p in prompts], dtype=np.int32
-    )
+    # Sweeps repeat a few unique prompts across many rows (Q listwise queries
+    # x N items; one calibration context per profile) — encode each once.
+    plen: Dict[str, int] = {}
+    for p in prompts:
+        if p not in plen:
+            plen[p] = len(engine.tokenizer.encode(p))
+    prefix_counts = np.array([plen[p] for p in prompts], dtype=np.int32)
     texts = [p + c for p, c in zip(prompts, continuations)]
     return _score_batch(engine, texts, prefix_counts)
 

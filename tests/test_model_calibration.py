@@ -112,9 +112,9 @@ class StubBackend:
 def profiles():
     return [
         Profile(id="m0", gender="male", age="25-34", occupation="pro",
-                watched_movies=["w"], favorite_genres=["Drama"], avg_rating=4.5),
+                watched_movies=["watched-m"], favorite_genres=["Drama"], avg_rating=4.5),
         Profile(id="f0", gender="female", age="25-34", occupation="pro",
-                watched_movies=["w"], favorite_genres=["Drama"], avg_rating=4.5),
+                watched_movies=["watched-f"], favorite_genres=["Drama"], avg_rating=4.5),
     ]
 
 
@@ -199,6 +199,64 @@ def test_model_calibration_golden_kept_set(profiles, monkeypatch, tmp_path):
         save_checkpoints=False, calibration="model",
     )
     assert kept == GOLDEN_KEPT
+
+
+def test_conditional_calibration_uses_profile_context(profiles, monkeypatch, tmp_path):
+    """calibration='model-conditional' must score each (profile, title) pair
+    with THAT profile's watch-history context — not a shared unconditional
+    score — and the context must carry no demographics."""
+    import fairness_llm_tpu.runtime.scoring as scoring
+
+    seen = {}
+
+    class FakeScores:
+        def __init__(self, titles):
+            self.mean_logprobs = np.array([LOGPROBS[t] for t in titles])
+
+    def fake_spc(engine, prompts, conts):
+        seen["prompts"], seen["conts"] = list(prompts), list(conts)
+        return FakeScores(conts)
+
+    monkeypatch.setattr(scoring, "score_prompted_continuations", fake_spc)
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    kept = apply_facter(
+        profiles, StubBackend(), config, variant="conformal",
+        save_checkpoints=False, calibration="model-conditional",
+    )
+    # one context row per (profile, title), profile-specific, no demographics
+    assert len(seen["prompts"]) == 12 and seen["conts"] == TITLES["m0"] + TITLES["f0"]
+    assert len(set(seen["prompts"])) == 2  # two distinct profile contexts
+    for p in seen["prompts"]:
+        assert "male" not in p and "female" not in p and "25-34" not in p
+        assert "enjoyed watched-" in p  # the watch history IS the context
+    # same logprob pattern as the unconditional golden -> same kept sets
+    assert kept == GOLDEN_KEPT
+
+
+def test_unknown_calibration_refused(profiles, tmp_path):
+    """A typo'd calibration name must fail loudly, not silently run the
+    simulated curve while the metadata records the requested name."""
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    with pytest.raises(ValueError, match="unknown calibration"):
+        apply_facter(
+            profiles, StubBackend(), config, variant="conformal",
+            save_checkpoints=False, calibration="model_conditional",  # underscore typo
+        )
+
+
+def test_conditional_calibration_requires_engine(profiles, tmp_path):
+    class NoEngine:
+        name = "sim"
+
+        def generate(self, prompts, settings=None, seed=0, keys=None, prefix_ids=None):
+            return ["\n".join(f"{j + 1}. {t}" for j, t in enumerate(TITLES[k])) for k in keys]
+
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    with pytest.raises(ValueError, match="EngineBackend"):
+        apply_facter(
+            profiles, NoEngine(), config, variant="conformal",
+            save_checkpoints=False, calibration="model-conditional",
+        )
 
 
 def test_confidence_temperature_reaches_mapping(profiles, monkeypatch, tmp_path):
