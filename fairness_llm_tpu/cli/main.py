@@ -99,9 +99,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(model-conditional; demographics excluded from the context)")
     p.add_argument("--confidence-mapping", default="percentile",
                    choices=("percentile", "probability"),
-                   help="with --calibration model: how likelihoods map onto the "
-                        "conformal scale (rank-normalized, or temperature-scaled "
-                        "probabilities — see pipeline.facter.model_confidences)")
+                   help="with --calibration model or model-conditional: how "
+                        "likelihoods map onto the conformal scale (rank-normalized, "
+                        "or temperature-scaled probabilities — see "
+                        "pipeline.facter.model_confidences)")
     p.add_argument("--confidence-temperature", type=float, default=1.0,
                    help="temperature for --confidence-mapping probability")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")

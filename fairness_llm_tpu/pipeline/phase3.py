@@ -272,6 +272,13 @@ def run_phase3(
 ) -> Dict:
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}")
+    if calibration not in ("simulated", "model", "model-conditional"):
+        # Fail before the (expensive) phase-1 load/run — apply_facter has the
+        # same guard but only fires after the fair re-prompting sweep.
+        raise ValueError(
+            f"unknown calibration {calibration!r} "
+            "(simulated | model | model-conditional)"
+        )
     if calibration != "simulated" and variant != "conformal":
         # smart/aggressive re-rank without conformal filtering, so model
         # calibration would be silently ignored — refuse instead of
