@@ -88,6 +88,8 @@ class Config:
         ("llama3-70b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("mistral-7b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("gemma-7b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("qwen2-0.5b", ModelSettings(temperature=0.7, max_tokens=500)),
+        ("qwen2-7b", ModelSettings(temperature=0.7, max_tokens=500)),
     )
 
     # --- TPU-native additions ---

@@ -35,6 +35,7 @@ class ModelConfig:
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     mlp: str = "glu"  # "glu" | "mlp"
     use_bias: bool = False  # biases on attention + MLP projections (gpt2 family)
+    qkv_bias: bool = False  # biases ONLY on q/k/v projections (qwen2 family)
     activation: str = "silu"  # "silu" | "gelu" | "gelu_tanh"
     embed_scale: bool = False  # gemma: scale embeddings by sqrt(d_model)
     tie_embeddings: bool = False
@@ -130,6 +131,20 @@ MODEL_CONFIGS = {
         num_kv_heads=16, d_model=3072, d_ff=24576, head_dim=256, max_seq_len=8192,
         activation="gelu_tanh", embed_scale=True, tie_embeddings=True,
         eos_token_id=1, pad_token_id=0,
+    ),
+    # Qwen2/2.5: llama-like (RMSNorm + SwiGLU + RoPE + GQA) with biases on
+    # the q/k/v projections only (qkv_bias).
+    "qwen2-0.5b": ModelConfig(
+        name="qwen2-0.5b", vocab_size=151936, num_layers=24, num_heads=14,
+        num_kv_heads=2, d_model=896, d_ff=4864, head_dim=64, max_seq_len=8192,
+        rope_theta=1000000.0, norm_eps=1e-6, qkv_bias=True, tie_embeddings=True,
+        eos_token_id=151643, pad_token_id=151643,
+    ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", vocab_size=152064, num_layers=28, num_heads=28,
+        num_kv_heads=4, d_model=3584, d_ff=18944, head_dim=128, max_seq_len=8192,
+        rope_theta=1000000.0, norm_eps=1e-6, qkv_bias=True,
+        eos_token_id=151643, pad_token_id=151643,
     ),
 }
 

@@ -193,9 +193,10 @@ class Attention(nn.Module):
         # are always causally visible; per-row positions are offset by Pc.
         cfg = self.config
         dtype = _dtype_of(cfg)
+        # qwen2 carries biases on q/k/v only (o_proj and MLP stay bias-free).
         dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
             feats,
-            use_bias=cfg.use_bias,
+            use_bias=cfg.use_bias or cfg.qkv_bias,
             dtype=dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ("embed", axes)

@@ -79,6 +79,16 @@ def _gemma_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
     return m
 
 
+def _qwen2_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
+    """Qwen2: llama naming plus biases on the q/k/v projections only."""
+    m = _llama_map(cfg)
+    for i in range(cfg.num_layers):
+        p, h = f"layer_{i}", f"model.layers.{i}"
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            m[f"{p}/attn/{proj}/bias"] = (f"{h}.self_attn.{proj}.bias", _ident)
+    return m
+
+
 def _gpt2_map(cfg: ModelConfig) -> Dict[str, Tuple[str, Transform]]:
     """GPT-2 Conv1D stores [in, out]; c_attn fuses qkv along the out axis."""
     d = cfg.d_model
@@ -116,15 +126,18 @@ _FAMILY_MAPS = {
     "mistral": _llama_map,
     "gemma": _gemma_map,
     "gpt2": _gpt2_map,
+    "qwen": _qwen2_map,
 }
 
 
 def family_of(cfg: ModelConfig) -> str:
     name = cfg.name.lower()
-    for fam in ("llama", "mistral", "gemma", "gpt2"):
+    for fam in ("llama", "mistral", "gemma", "gpt2", "qwen"):
         if fam in name.replace("-", ""):
             return fam
     # tiny test configs: pick by flags
+    if cfg.qkv_bias:
+        return "qwen"
     return "gpt2" if cfg.pos_emb == "learned" else "llama"
 
 

@@ -17,6 +17,7 @@ Covers, per family:
 - gemma: sqrt(d_model) embed scale, ``1 + weight`` RMSNorm, tied embeds
 - gpt2: fused-QKV Conv1D split (no transpose), learned positions, gelu_tanh
 - mistral: sliding-window masking at S > window
+- qwen2: biases on q/k/v projections only (qkv_bias), tied embeds
 plus the cached decode path (greedy parity vs ``generate``), the left-padded
 batch layout, and the ``HFTokenizer`` adapter over a real tokenizer dir.
 """
@@ -90,6 +91,17 @@ def _build(family: str):
         ))
         cfg = ModelConfig(**common, num_kv_heads=2, norm_eps=1e-5,
                           sliding_window=8)
+    elif family == "qwen2":
+        hf = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(
+            vocab_size=t["vocab"], hidden_size=t["d"], intermediate_size=t["ff"],
+            num_hidden_layers=t["layers"], num_attention_heads=t["heads"],
+            num_key_value_heads=2, head_dim=16, max_position_embeddings=t["seq"],
+            rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=True,
+            attn_implementation="eager",
+        ))
+        cfg = ModelConfig(**{**common, "name": "tiny-qwen2-parity"},
+                          num_kv_heads=2, norm_eps=1e-6, qkv_bias=True,
+                          tie_embeddings=True)
     else:
         raise KeyError(family)
     return hf.eval(), cfg
@@ -100,7 +112,7 @@ def _load(hf, cfg, path):
     return load_checkpoint(cfg, str(path), dtype=np.float32)
 
 
-FAMILIES = ["llama", "llama-tied", "gemma", "gpt2", "mistral"]
+FAMILIES = ["llama", "llama-tied", "gemma", "gpt2", "mistral", "qwen2"]
 
 
 @pytest.mark.parametrize("family", FAMILIES)
