@@ -99,9 +99,8 @@ def _build(family: str):
             rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=True,
             attn_implementation="eager",
         ))
-        cfg = ModelConfig(**{**common, "name": "tiny-qwen2-parity"},
-                          num_kv_heads=2, norm_eps=1e-6, qkv_bias=True,
-                          tie_embeddings=True)
+        cfg = ModelConfig(**common, num_kv_heads=2, norm_eps=1e-6,
+                          qkv_bias=True, tie_embeddings=True)
     else:
         raise KeyError(family)
     return hf.eval(), cfg
