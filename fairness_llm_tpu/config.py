@@ -96,7 +96,10 @@ class Config:
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     decode_batch_size: int = 16
     max_prompt_len: int = 512
-    max_new_tokens: int = 256
+    # Global cap on decode length: settings_for() clamps each model's
+    # max_tokens to this, bounding per-sweep decode cost from one knob.
+    # Default 512 >= every per-model setting, so defaults change nothing.
+    max_new_tokens: int = 512
     weights_dir: Optional[str] = None  # directory of HF safetensors checkpoints
     checkpoint_every: int = 20  # profiles between sweep checkpoints (reference: 20)
     profile_trace_dir: Optional[str] = None  # jax.profiler trace output
@@ -104,6 +107,10 @@ class Config:
     def settings_for(self, model_name: str) -> ModelSettings:
         for name, settings in self.model_settings:
             if name == model_name:
+                if settings.max_tokens > self.max_new_tokens:
+                    settings = dataclasses.replace(
+                        settings, max_tokens=self.max_new_tokens
+                    )
                 return settings
         raise KeyError(
             f"no decode settings for model '{model_name}'; "

@@ -12,7 +12,6 @@ Pinned properties:
 
 import zlib
 
-import numpy as np
 import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
