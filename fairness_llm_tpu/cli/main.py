@@ -105,6 +105,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "pipeline.facter.model_confidences)")
     p.add_argument("--confidence-temperature", type=float, default=1.0,
                    help="temperature for --confidence-mapping probability")
+    p.add_argument("--max-new-tokens", type=int, default=None,
+                   help="global decode-length cap: clamps every model's "
+                        "max_tokens (bounds per-sweep decode cost)")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--data-dir", default=None, help="MovieLens-1M directory")
@@ -133,6 +136,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["random_seed"] = args.seed
     if args.trace_dir:
         updates["profile_trace_dir"] = args.trace_dir
+    if args.max_new_tokens is not None:
+        if args.max_new_tokens < 1:
+            # A zero cap would reach the engine as a [B, 0] decode buffer and
+            # die inside jit with an opaque dynamic_update_slice error.
+            raise SystemExit("--max-new-tokens must be >= 1")
+        updates["max_new_tokens"] = args.max_new_tokens
     if args.quick:
         updates["profiles_per_combo"] = 1
     if updates:
