@@ -14,9 +14,10 @@ import pathlib
 import pytest
 
 from fairness_llm_tpu.config import Config
-from fairness_llm_tpu.pipeline import run_phase1, run_phase3
+from fairness_llm_tpu.pipeline import run_phase1, run_phase2, run_phase3
 
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent.parent / "results"
+DATA_DIR = pathlib.Path(__file__).resolve().parent.parent / "data" / "ml-1m"
 
 ATOL = 1e-4  # float32 metric kernels
 
@@ -32,14 +33,13 @@ def golden_phase1():
 
 @pytest.fixture(scope="module")
 def fresh_phase1(tmp_path_factory):
-    data_dir = pathlib.Path(__file__).resolve().parent.parent / "data" / "ml-1m"
-    if (data_dir / "movies.dat").exists():
+    if (DATA_DIR / "movies.dat").exists():
         pytest.skip(
             "real ML-1M present: the committed record was produced on the "
             "synthetic fallback — regenerate results/ (see results/README.md)"
         )
     config = Config(
-        results_dir=str(tmp_path_factory.mktemp("golden")), data_dir=str(data_dir)
+        results_dir=str(tmp_path_factory.mktemp("golden")), data_dir=str(DATA_DIR)
     )
     return config, run_phase1(config, model_name="simulated", save=False)
 
@@ -71,6 +71,33 @@ def test_phase1_recommendations_match_committed_record(golden_phase1, fresh_phas
     assert set(g_recs) == set(f_recs)
     for pid in g_recs:
         assert g_recs[pid]["recommendations"] == f_recs[pid]["recommendations"], pid
+
+
+def test_phase2_movielens_at_scale_matches_committed_record(tmp_path):
+    """The at-scale phase-2 surface (200 ML-1M items, 4 queries, three
+    bias-variant models) has its own committed record; re-running must
+    reproduce every model's fairness numbers AND show the bias gradient
+    (fair > default > biased on listwise exposure)."""
+    path = GOLDEN_DIR / "phase2" / "phase2_movielens_results.json"
+    if not path.exists():
+        pytest.skip("no committed at-scale record")
+    with open(path) as f:
+        golden = json.load(f)
+
+    if (DATA_DIR / "movies.dat").exists():
+        pytest.skip("real ML-1M present: record was produced on the synthetic fallback")
+    config = Config(results_dir=str(tmp_path), data_dir=str(DATA_DIR))
+    fresh = run_phase2(
+        config, models=["simulated-fair", "simulated", "simulated-biased"],
+        corpus="movielens", num_items=200, num_queries=4, num_comparisons=60,
+        save=False,
+    )
+    g, f = golden["comparison"]["model_fairness"], fresh["comparison"]["model_fairness"]
+    for model in g:
+        for key in ("listwise_fairness", "pairwise_fairness", "average_fairness"):
+            assert f[model][key] == pytest.approx(g[model][key], abs=ATOL), (model, key)
+    lw = {m: f[m]["listwise_fairness"] for m in f}
+    assert lw["simulated-fair"] > lw["simulated"] > lw["simulated-biased"]
 
 
 def test_phase3_conformal_matches_committed_record(fresh_phase1):
