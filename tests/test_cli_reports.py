@@ -28,3 +28,19 @@ def test_cli_all_quick(tmp_path, capsys):
 def test_cli_single_phase(tmp_path):
     rc = main(["--phase", "2", "--quick", "--results-dir", str(tmp_path), "--no-save"])
     assert rc == 0
+
+
+def test_phase2_figure_multi_model(tmp_path):
+    """The ranking-fairness figure with several models (grouped bars +
+    per-group exposure panel for the first model)."""
+    from fairness_llm_tpu.config import Config
+    from fairness_llm_tpu.pipeline.phase2 import run_phase2
+    from fairness_llm_tpu.reports import generate_phase2_figure
+
+    config = Config(results_dir=str(tmp_path), data_dir="/nonexistent")
+    res = run_phase2(
+        config, models=["simulated-fair", "simulated-biased"], corpus="movielens",
+        num_items=30, num_queries=2, num_comparisons=6, save=False,
+    )
+    path = generate_phase2_figure(res, str(tmp_path / "viz"))
+    assert os.path.exists(path) and os.path.getsize(path) > 10_000
