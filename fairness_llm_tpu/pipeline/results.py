@@ -20,9 +20,25 @@ logger = logging.getLogger(__name__)
 
 
 def save_results(results: Dict[str, Any], path: str) -> None:
+    """Atomic-rename write: a PROCESS interrupt mid-write leaves the previous
+    file intact (resume depends on it). fsync before rename extends that to
+    most system-crash orderings too, though no rename dance is a durability
+    guarantee across power loss — the resume loader's corrupt-file fallback
+    is the final backstop."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(results, f, indent=2, default=str)
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=2, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:  # noqa: BLE001 — incl. KeyboardInterrupt: no tmp litter
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     logger.info("saved results to %s", path)
 
 
@@ -49,21 +65,35 @@ def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
     d = os.path.join(results_dir, phase)
     if not os.path.isdir(d):
         return {}
-    best, best_n = None, -1
+    numbered = []
     for fname in os.listdir(d):
         if fname.startswith(f"{phase}_checkpoint_") and fname.endswith(".json"):
             try:
                 n = int(fname[len(f"{phase}_checkpoint_"):-len(".json")])
             except ValueError:
                 continue
-            if n > best_n:
-                best, best_n = fname, n
-    if best is None:
-        return {}
-    data = load_results(os.path.join(d, best)) or {}
-    recs = data.get("recommendations", {})
-    # Never resume a contained failure as completed work.
-    recs = {k: v for k, v in recs.items() if not (isinstance(v, dict) and v.get("error"))}
-    if recs:
-        logger.info("resuming from checkpoint %s (%d profiles done)", best, len(recs))
-    return recs
+            numbered.append((n, fname))
+    # Newest first; fall back through older checkpoints if one is unreadable
+    # (writes are atomic now, but checkpoints from older versions — or a
+    # filesystem mishap — shouldn't make resume WORSE than starting over).
+    for _, fname in sorted(numbered, reverse=True):
+        try:
+            data = load_results(os.path.join(d, fname)) or {}
+        except (json.JSONDecodeError, OSError) as e:
+            logger.warning("skipping unreadable checkpoint %s: %s", fname, e)
+            continue
+        recs = data.get("recommendations", {}) if isinstance(data, dict) else None
+        if not isinstance(recs, dict):
+            # Valid JSON, wrong shape (e.g. a list, or recommendations: null):
+            # still corruption — resume must not crash on it.
+            logger.warning("skipping malformed checkpoint %s", fname)
+            continue
+        # Never resume a contained failure as completed work.
+        recs = {
+            k: v for k, v in recs.items()
+            if not (isinstance(v, dict) and v.get("error"))
+        }
+        if recs:
+            logger.info("resuming from checkpoint %s (%d profiles done)", fname, len(recs))
+        return recs
+    return {}

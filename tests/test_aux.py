@@ -95,3 +95,48 @@ def test_failed_decodes_not_resumed(tmp_path):
     )
     loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
     assert "ok" in loaded and "bad" not in loaded
+
+
+def test_results_write_is_atomic(tmp_path, monkeypatch):
+    """An interrupt mid-write must leave the previous file intact — resume
+    depends on checkpoints never being truncated JSON."""
+    import json
+
+    from fairness_llm_tpu.pipeline import results as R
+
+    path = tmp_path / "phase1" / "phase1_checkpoint_2.json"
+    R.save_checkpoint({"a": {"recommendations": ["x"], "raw_response": "r"}},
+                      str(tmp_path), "phase1", 2)
+    before = path.read_text()
+
+    def exploding_dump(*a, **k):
+        raise KeyboardInterrupt  # simulated interrupt mid-serialization
+
+    monkeypatch.setattr(json, "dump", exploding_dump)
+    try:
+        R.save_checkpoint({"b": {}}, str(tmp_path), "phase1", 2)
+    except KeyboardInterrupt:
+        pass
+    assert path.read_text() == before  # old checkpoint untouched
+    assert json.loads(before)  # and still valid JSON
+    assert not list(path.parent.glob("*.tmp"))  # no tmp litter either
+
+
+def test_resume_falls_back_past_corrupt_checkpoint(tmp_path):
+    """A truncated newest checkpoint (older framework versions wrote
+    non-atomically) must not make resume worse than starting over: fall back
+    to the newest readable one."""
+    from fairness_llm_tpu.pipeline import results as R
+
+    R.save_checkpoint({"a": {"recommendations": ["x"], "raw_response": "r"}},
+                      str(tmp_path), "phase1", 16)
+    # newest checkpoint is truncated garbage
+    bad = tmp_path / "phase1" / "phase1_checkpoint_32.json"
+    bad.write_text('{"completed": 32, "recommendations": {"a": {')
+    loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
+    assert loaded == {"a": {"recommendations": ["x"], "raw_response": "r"}}
+    # valid-JSON-but-wrong-shape corruption must also fall through
+    for payload in ("[1, 2]", '{"recommendations": null}', '"just a string"'):
+        bad.write_text(payload)
+        loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
+        assert loaded == {"a": {"recommendations": ["x"], "raw_response": "r"}}, payload
