@@ -21,7 +21,13 @@ static const char *parse_long(const char *p, const char *end, long *out) {
     long v = 0;
     int neg = 0;
     if (p < end && *p == '-') { neg = 1; p++; }
-    while (p < end && *p >= '0' && *p <= '9') { v = v * 10 + (*p - '0'); p++; }
+    while (p < end && *p >= '0' && *p <= '9') {
+        /* Clamp instead of overflowing: a hostile digit run must not trigger
+         * signed-overflow UB. Real ids are < 2^31; clamped rows then fail the
+         * int32 range downstream rather than corrupting memory semantics. */
+        if (v < (1L << 56)) v = v * 10 + (*p - '0');
+        p++;
+    }
     *out = neg ? -v : v;
     return p;
 }
@@ -57,9 +63,12 @@ long parse_ratings(const char *path, int32_t *users, int32_t *movies,
                    float *values, long out_cap) {
     FILE *f = fopen(path, "rb");
     if (!f) return -1;
-    fseek(f, 0, SEEK_END);
+    /* ftell on a non-seekable path (FIFO) returns -1; feeding that size to
+     * malloc/fread would be a 0-byte buffer with an unbounded read. */
+    if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return -1; }
     long size = ftell(f);
-    fseek(f, 0, SEEK_SET);
+    if (size < 0) { fclose(f); return -1; }
+    if (fseek(f, 0, SEEK_SET) != 0) { fclose(f); return -1; }
     char *buf = (char *)malloc(size + 1);
     if (!buf) { fclose(f); return -1; }
     if ((long)fread(buf, 1, size, f) != size) { free(buf); fclose(f); return -1; }
@@ -98,6 +107,10 @@ long parse_ratings(const char *path, int32_t *users, int32_t *movies,
          * float() rejects too. */
         if (q < end && *q == ':' && (q + 1 >= end || q[1] != ':')) { free(buf); return -3; }
         p = q;
+        /* int32 range check: the pure-Python fallback raises OverflowError on
+         * out-of-range ids; silent (int32_t) truncation would diverge. */
+        if (user > 2147483647L || user < -2147483648L ||
+            movie > 2147483647L || movie < -2147483648L) { free(buf); return -3; }
         users[n] = (int32_t)user;
         movies[n] = (int32_t)movie;
         values[n] = (float)val;

@@ -26,7 +26,9 @@ def save_results(results: Dict[str, Any], path: str) -> None:
     guarantee across power loss — the resume loader's corrupt-file fallback
     is the final backstop."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp"
+    # Per-pid tmp name: concurrent writers (multi-host ranks, pytest -n) must
+    # not truncate each other's in-flight tmp before its atomic rename.
+    tmp = f"{path}.{os.getpid()}.tmp"
     try:
         with open(tmp, "w") as f:
             json.dump(results, f, indent=2, default=str)
@@ -79,7 +81,9 @@ def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
     for _, fname in sorted(numbered, reverse=True):
         try:
             data = load_results(os.path.join(d, fname)) or {}
-        except (json.JSONDecodeError, OSError) as e:
+        except (ValueError, OSError) as e:
+            # ValueError covers json.JSONDecodeError AND UnicodeDecodeError
+            # (byte-level truncation inside a multi-byte character).
             logger.warning("skipping unreadable checkpoint %s: %s", fname, e)
             continue
         recs = data.get("recommendations", {}) if isinstance(data, dict) else None
