@@ -11,9 +11,13 @@ prefill -> 128 scan decode steps -> detokenize. Model is gpt2-small
 values don't change FLOPs or memory traffic, so throughput is representative
 while requiring no checkpoint download.
 
-Baseline: the reference runs the same sweep as sequential OpenAI API calls —
-~15 min for 45 profiles per its README runtime estimate (SURVEY.md §6), i.e.
-0.05 profiles/sec. ``vs_baseline`` is the speedup over that.
+``vs_baseline`` is the HONEST headline: achieved decode bandwidth as a
+fraction of this chip's MEASURED achievable streaming bandwidth (1.0 =
+decode at the hardware wall; falls back to the fraction of the 819 GB/s v5e
+spec roofline if the in-run probe fails — ``baseline`` says which). The
+reference-API comparison (its README estimates ~15 min for the 45-profile
+sweep of sequential OpenAI calls, SURVEY.md §6 — a strawman next to
+hardware-limit accounting) lives in ``detail.vs_reference_api_sweep``.
 
 Run: python bench.py          (uses the default backend — TPU when present)
      BENCH_MODEL=tiny-test python bench.py   (smoke on CPU)
@@ -57,6 +61,151 @@ def decode_step_bytes(config, stats, param_dtype_bytes: int) -> int:
         config.num_kv_heads * config.head_dim * model_item * 2 * config.num_layers
     )
     return params + kv + prefix
+
+
+def measure_achievable_gbps() -> float | None:
+    """This chip's ACHIEVABLE streaming bandwidth, measured in-run.
+
+    The spec roofline (819 GB/s for v5e) is not what a tunneled chip actually
+    serves; docs/PERFORMANCE.md round-2 probes measured ~260-300 GB/s on any
+    access pattern. This puts that probe IN the bench (VERDICT r2 item 5) so
+    every BENCH_r*.json can say whether decode is at the wall without
+    re-deriving the experiment: a fori_loop whose carry feeds each iteration's
+    element-wise read (acc-dependent ``minimum`` — loop-invariant code motion
+    cannot hoist the re-read), timed with a value-forcing sync.
+    """
+    if jax.default_backend() != "tpu":
+        # ~174 GB of host-memory traffic for a number that means nothing off
+        # the chip; the headline then falls back to the spec-roofline fraction.
+        return None
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 85_000_000  # f32 -> 340 MB, far over any cache tier
+    reps = 128  # ~43.5 GB of traffic: >50 ms even at spec bandwidth, so the
+    # tunnel's dispatch latency becomes a small correction, not the signal
+    x = jax.device_put(jnp.ones((n,), jnp.float32))
+
+    @jax.jit
+    def probe(x, start):
+        def body(_, acc):
+            return acc + jnp.sum(jnp.minimum(x, acc))
+
+        return lax.fori_loop(0, reps, body, start)
+
+    @jax.jit
+    def tiny(start):  # same dispatch+sync shape, ~zero bytes: measures latency
+        return start + 1.0
+
+    def timed(fn, *args):
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fn(*args))  # value-forced sync (tunnel-safe)
+            wall = time.perf_counter() - t0
+            best = wall if best is None else min(best, wall)
+        return best
+
+    try:
+        float(probe(x, jnp.float32(1e30)))  # compile + warm
+        float(tiny(jnp.float32(0.0)))
+        latency = timed(tiny, jnp.float32(0.0))
+        wall = timed(probe, x, jnp.float32(1e30))
+        return reps * x.nbytes / max(wall - latency, 1e-6) / 1e9
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"bandwidth probe skipped: {type(e).__name__}: {e}", file=sys.stderr)
+        return None
+
+
+def flash_memory_proof() -> dict | None:
+    """Compile-time proof of flash attention's decisive claim: at ~150 ranked
+    items (S≈7k) the DENSE prefill's [B, H, S, S] score tensors (~9.2 GB
+    each) overflow one v5e chip's HBM — the TPU compiler itself REJECTS the
+    program at compile time ("Ran out of memory in memory space hbm", ~18.4 G
+    needed of 15.75 G) — while flash streams k/v blocks through VMEM and
+    compiles comfortably (docs/PERFORMANCE.md round-2; VERDICT r2 item 6).
+    Nothing is executed, so the dense side can't actually OOM the bench.
+    TPU-only (flash is a Pallas kernel)."""
+    if jax.default_backend() != "tpu":
+        return None
+    import dataclasses
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.models.transformer import Transformer
+
+    import re
+
+    B, S = 4, 7168  # ~150 byte-tokenized ML-1M items per listwise prompt
+    cfg = get_model_config("gpt2-small")
+    out = {"batch": B, "seq": S}
+    try:
+        for label, flash in (("dense", False), ("flash", True)):
+            c = dataclasses.replace(cfg, max_seq_len=8192, use_flash_attention=flash)
+            model = Transformer(c)
+            abstract = jax.eval_shape(
+                model.init, jax.random.key(0),
+                jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32),
+            )
+            aparams = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                nn.meta.unbox(abstract["params"]),
+            )
+
+            def fwd(params, tokens, positions, valid):
+                # left_padded=True: the engine's layout promise, and the
+                # static gate for the Pallas flash path (models/transformer.py
+                # _flash_ok) — without it both sides compile dense.
+                logits, _ = model.apply(
+                    {"params": params}, tokens, positions, valid,
+                    last_only=True, left_padded=True,
+                )
+                return logits
+
+            arg = lambda dt: jax.ShapeDtypeStruct((B, S), dt)  # noqa: E731
+            try:
+                compiled = (
+                    jax.jit(fwd)
+                    .lower(aparams, arg(jnp.int32), arg(jnp.int32), arg(jnp.bool_))
+                    .compile()
+                )
+            except Exception as e:  # noqa: BLE001 — compile-OOM is the signal
+                msg = str(e)
+                if "Ran out of memory" not in msg or "hbm" not in msg:
+                    raise
+                m = re.search(r"Used ([0-9.]+)G of ([0-9.]+)G hbm", msg)
+                out[label] = {
+                    "compiles": False,
+                    "compile_oom": True,
+                    "hbm_needed_gb": float(m.group(1)) if m else None,
+                    "hbm_capacity_gb": float(m.group(2)) if m else None,
+                }
+                continue
+            ma = compiled.memory_analysis()
+            out[label] = {
+                "compiles": True,
+                "temp_gb": round(ma.temp_size_in_bytes / 1e9, 2),
+                "total_gb": round(
+                    (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                     + ma.output_size_in_bytes) / 1e9, 2),
+            }
+            del compiled
+        # The claim holds when dense is compiler-rejected (or needs more than
+        # the chip) while flash compiles and fits.
+        dense, flash_r = out.get("dense", {}), out.get("flash", {})
+        out["proven"] = bool(
+            (not dense.get("compiles", True)
+             or dense.get("total_gb", 0) > 15.75)
+            and flash_r.get("compiles")
+            and flash_r.get("total_gb", 1e9) < 15.75
+        )
+        return out
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"flash memory proof skipped: {type(e).__name__}: {e}", file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+        return out
 
 
 def build_sweep_prompts():
@@ -260,6 +409,7 @@ def _run() -> None:
     # long-context engines spin up — at 1B/3B scale keeping it alive OOMs the
     # auxiliary measurement.
     del engine, out
+    achievable_gbps = measure_achievable_gbps()
     phase2_listwise = None
     for attempt in (1, 2):  # transient tunnel drops cost one compile; retry once
         try:
@@ -270,12 +420,33 @@ def _run() -> None:
                 f"phase2-listwise attempt {attempt} failed: {type(e).__name__}: {e}",
                 file=sys.stderr,
             )
+    flash_proof = flash_memory_proof()
 
+    # Headline comparison: achieved decode bandwidth over this chip's MEASURED
+    # achievable bandwidth (the honest "are we at the wall" number — VERDICT
+    # r2 item 8). The reference-API speedup multiple (a strawman: 45 profiles
+    # / ~15 min of HTTPS round-trips) is kept as a detail field.
+    achieved_over_achievable = (
+        round(achieved_gbps / achievable_gbps, 3) if achievable_gbps else None
+    )
     result = {
         "metric": f"phase1_sweep_decode_throughput[{model_name},{devices[0].platform}]",
         "value": round(profiles_per_sec, 3),
         "unit": "profiles/sec/chip",
-        "vs_baseline": round(profiles_per_sec / REFERENCE_PROFILES_PER_SEC, 1),
+        "vs_baseline": (
+            achieved_over_achievable
+            if achieved_over_achievable is not None
+            else round(achieved_gbps / V5E_HBM_GBPS, 3)
+        ),
+        "baseline": (
+            "fraction of this chip's measured achievable HBM streaming "
+            "bandwidth (1.0 = decode at the wall); API-sweep multiple in "
+            "detail.vs_reference_api_sweep"
+            if achieved_over_achievable is not None
+            else "fraction of the 819 GB/s v5e SPEC roofline (bandwidth probe "
+                 "failed this run); API-sweep multiple in "
+                 "detail.vs_reference_api_sweep"
+        ),
         "detail": {
             "profiles": len(prompts),
             "max_new_tokens": MAX_NEW_TOKENS,
@@ -285,13 +456,24 @@ def _run() -> None:
             "decode_shape": sweep_stats,
             "decode_bytes_per_step_mb": round(step_bytes / 1e6, 1),
             "achieved_hbm_gbps": round(achieved_gbps, 1),
+            "achievable_hbm_gbps_probe": (
+                round(achievable_gbps, 1) if achievable_gbps else None
+            ),
+            "achieved_over_achievable": achieved_over_achievable,
             "pct_v5e_hbm_roofline": round(100 * achieved_gbps / V5E_HBM_GBPS, 1),
+            "vs_reference_api_sweep": round(
+                profiles_per_sec / REFERENCE_PROFILES_PER_SEC, 1
+            ),
             "large_sweep_profiles_per_sec": round(big_rate, 3) if big_rate else None,
             "large_sweep_int8kv_profiles_per_sec": (
                 round(big_rate_int8, 3) if big_rate_int8 else None
             ),
             "phase2_listwise": phase2_listwise,
-            "baseline": "reference README: ~15 min for the 45-profile sweep via API",
+            "flash_memory_proof": flash_proof,
+            "reference_api_baseline": (
+                "reference README: ~15 min for the 45-profile sweep via API "
+                "(what vs_reference_api_sweep is measured against)"
+            ),
         },
     }
     print(json.dumps(result))

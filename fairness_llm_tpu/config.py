@@ -81,6 +81,8 @@ class Config:
     model_settings: Tuple[Tuple[str, ModelSettings], ...] = (
         ("tiny-test", ModelSettings(temperature=0.7, max_tokens=128)),
         ("tiny-gpt2", ModelSettings(temperature=0.7, max_tokens=128)),
+        ("tiny-llama-study", ModelSettings(temperature=0.7, max_tokens=64)),
+        ("tiny-gpt2-study", ModelSettings(temperature=0.7, max_tokens=64)),
         ("gpt2-small", ModelSettings(temperature=0.7, max_tokens=256)),
         ("llama32-1b", ModelSettings(temperature=0.7, max_tokens=500)),
         ("llama32-3b", ModelSettings(temperature=0.7, max_tokens=500)),

@@ -52,17 +52,38 @@ def demographic_parity_kernel(group_counts: jnp.ndarray) -> Tuple[jnp.ndarray, j
     return 1.0 - avg, js
 
 
-def demographic_parity(
-    recommendations_by_group: Dict[str, List[List[str]]],
-) -> Tuple[float, Dict]:
-    """Reference-parity wrapper: dict of group -> list of rec lists."""
-    groups = list(recommendations_by_group.keys())
+def _flatten_groups(recommendations_by_group, groups):
+    """Per-profile rec rows + owning-group index, in group order."""
     flat: List[List[str]] = []
     owners: List[int] = []
     for gi, g in enumerate(groups):
         for recs in recommendations_by_group[g]:
             flat.append(list(recs))
             owners.append(gi)
+    return flat, owners
+
+
+def _host_group_counts(per_list: np.ndarray, owners: np.ndarray, num_groups: int) -> np.ndarray:
+    """Default [N, V] -> [G, V] reduction: host-side scatter-add. The
+    dp-sharded study swaps in ``metrics.sharded``'s psum reduction via the
+    wrappers' ``group_counts_fn`` hook — everything around the reduction
+    (interning, kernels, detail formatting) is shared so the two paths cannot
+    drift."""
+    out = np.zeros((num_groups, per_list.shape[1]), dtype=np.float32)
+    np.add.at(out, owners, per_list)
+    return out
+
+
+def demographic_parity(
+    recommendations_by_group: Dict[str, List[List[str]]],
+    group_counts_fn=None,
+) -> Tuple[float, Dict]:
+    """Reference-parity wrapper: dict of group -> list of rec lists.
+
+    ``group_counts_fn(per_list [N, V], owners [N], num_groups) -> [G, V]``
+    overrides the count reduction (see ``_host_group_counts``)."""
+    groups = list(recommendations_by_group.keys())
+    flat, owners = _flatten_groups(recommendations_by_group, groups)
     if not flat:
         # Reference semantics (utils.py:207-209): no comparable pairs -> avg
         # divergence 0 -> parity 1.0 (vacuously fair), not 0.0.
@@ -70,10 +91,13 @@ def demographic_parity(
 
     ids, vocab = encode_rec_lists(flat)
     per_list = count_matrix(ids, len(vocab))  # [N, V]
-    group_counts = np.zeros((len(groups), len(vocab)), dtype=np.float32)
-    np.add.at(group_counts, np.asarray(owners), per_list)
+    reduce = group_counts_fn or _host_group_counts
+    group_counts = reduce(per_list, np.asarray(owners, np.int32), len(groups))
 
+    # jnp.asarray is a no-op for an already-on-device reduction result; the
+    # host copy is materialized once, for the detail dict below.
     score, js = demographic_parity_kernel(jnp.asarray(group_counts))
+    group_counts = np.asarray(group_counts)
     js = np.asarray(js)
     totals = group_counts.sum(axis=-1)
 
@@ -163,20 +187,21 @@ def equal_opportunity_kernel(
 def equal_opportunity(
     recommendations_by_group: Dict[str, List[List[str]]],
     relevant_items: Set[str],
+    group_counts_fn=None,
 ) -> Tuple[float, Dict[str, float]]:
-    """Reference-parity wrapper."""
+    """Reference-parity wrapper (``group_counts_fn`` as in
+    ``demographic_parity``; hit-rate math is reduction-invariant because it
+    only needs the summed [G, V] counts)."""
     groups = list(recommendations_by_group.keys())
     if not groups:
         return 1.0, {}
-    vocab = Vocab()
-    group_rows = []
-    for g in groups:
-        flat = [item for recs in recommendations_by_group[g] for item in recs]
-        group_rows.append(flat)
-    ids, vocab = encode_rec_lists(group_rows, vocab)
+    flat, owners = _flatten_groups(recommendations_by_group, groups)
+    ids, vocab = encode_rec_lists(flat) if flat else (np.zeros((0, 1), np.int32), Vocab())
     for item in relevant_items:
         vocab.add(item)
-    counts = count_matrix(ids, len(vocab))
+    per_list = count_matrix(ids, len(vocab)) if flat else np.zeros((0, len(vocab)), np.float32)
+    reduce = group_counts_fn or _host_group_counts
+    counts = reduce(per_list, np.asarray(owners, np.int32), len(groups))
     relevant_mask = np.zeros(len(vocab), dtype=bool)
     for item in relevant_items:
         relevant_mask[vocab[item]] = True

@@ -14,42 +14,116 @@ This is the TPU analog of the reference's host-side numpy aggregation
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Dict, List, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fairness_llm_tpu.metrics.fairness import demographic_parity_kernel
+from fairness_llm_tpu.metrics.encode import Vocab, count_matrix, encode_rec_lists
+from fairness_llm_tpu.metrics.fairness import (
+    demographic_parity_kernel,
+    equal_opportunity_kernel,
+)
 
 
-def sharded_demographic_parity(
+def sharded_group_counts(
     mesh: Mesh,
     per_profile_counts: jnp.ndarray,  # [N, V] float32 — N profiles, V vocab
     group_ids: jnp.ndarray,  # [N] int32
     num_groups: int,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Demographic parity with the group-count accumulation dp-sharded.
+) -> jnp.ndarray:
+    """[N, V] dp-sharded per-profile counts -> [G, V] group counts, replicated.
 
     Profiles shard over ``dp``; each device segment-sums its local profiles
-    into [G, V] and ``psum`` completes the reduction over ICI. Returns
-    (score, [G, G] JS matrix), replicated.
+    into [G, V] and ``psum`` completes the reduction over ICI. N must be a
+    multiple of the dp axis (callers zero-pad; zero rows contribute nothing).
     """
     from jax import shard_map
 
     def local_reduce(counts, gids):
         local = jax.ops.segment_sum(counts, gids, num_segments=num_groups)  # [G, V]
-        total = jax.lax.psum(local, "dp")
-        score, js = demographic_parity_kernel(total)
-        return score, js
+        return jax.lax.psum(local, "dp")
 
     fn = shard_map(
         local_reduce,
         mesh=mesh,
         in_specs=(P("dp", None), P("dp")),
-        out_specs=(P(), P()),
+        out_specs=P(),
         check_vma=False,
     )
     counts_sharded = jax.device_put(per_profile_counts, NamedSharding(mesh, P("dp", None)))
     gids_sharded = jax.device_put(group_ids, NamedSharding(mesh, P("dp")))
     return fn(counts_sharded, gids_sharded)
+
+
+def sharded_demographic_parity(
+    mesh: Mesh,
+    per_profile_counts: jnp.ndarray,
+    group_ids: jnp.ndarray,
+    num_groups: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Demographic parity with the group-count accumulation dp-sharded;
+    returns (score, [G, G] JS matrix), replicated."""
+    total = sharded_group_counts(mesh, per_profile_counts, group_ids, num_groups)
+    return demographic_parity_kernel(total)
+
+
+def _pad_to_dp(mesh: Mesh, counts: np.ndarray, owners: List[int]):
+    """Zero-pad [N, V] rows (owner 0, zero counts — inert) to a dp multiple,
+    the shard_map layout requirement."""
+    dp = mesh.shape.get("dp", 1)
+    pad = (-counts.shape[0]) % dp
+    if pad:
+        counts = np.concatenate(
+            [counts, np.zeros((pad, counts.shape[1]), counts.dtype)]
+        )
+        owners = list(owners) + [0] * pad
+    return counts, np.asarray(owners, np.int32)
+
+
+def _mesh_group_counts_fn(mesh: Mesh):
+    """A ``group_counts_fn`` (see ``metrics.fairness.demographic_parity``)
+    that reduces [N, V] -> [G, V] on device via psum over dp. Everything
+    around the reduction — interning, kernels, detail formatting — is the
+    host wrappers' shared code, so the two paths cannot drift."""
+
+    def reduce(per_list: np.ndarray, owners: np.ndarray, num_groups: int):
+        per_list, owners = _pad_to_dp(mesh, per_list, list(owners))
+        return sharded_group_counts(
+            mesh, jnp.asarray(per_list), jnp.asarray(owners), num_groups
+        )
+
+    return reduce
+
+
+def demographic_parity_on_mesh(
+    mesh: Mesh,
+    recommendations_by_group: Dict[str, List[List[str]]],
+) -> Tuple[float, Dict]:
+    """``metrics.fairness.demographic_parity`` with the [N, V] accumulation
+    reduced ON DEVICE (psum over dp) — the SURVEY §7.2 study path. Host work
+    is limited to string interning (strings can't live on device) and
+    formatting the tiny replicated [G, V] result. Equality with the host path
+    is asserted study-level in ``tests/test_pipeline_sharded.py``."""
+    from fairness_llm_tpu.metrics.fairness import demographic_parity
+
+    return demographic_parity(
+        recommendations_by_group, group_counts_fn=_mesh_group_counts_fn(mesh)
+    )
+
+
+def equal_opportunity_on_mesh(
+    mesh: Mesh,
+    recommendations_by_group: Dict[str, List[List[str]]],
+    relevant_items: Set[str],
+) -> Tuple[float, Dict[str, float]]:
+    """``metrics.fairness.equal_opportunity`` with the count accumulation
+    psum-reduced over dp."""
+    from fairness_llm_tpu.metrics.fairness import equal_opportunity
+
+    return equal_opportunity(
+        recommendations_by_group, relevant_items,
+        group_counts_fn=_mesh_group_counts_fn(mesh),
+    )

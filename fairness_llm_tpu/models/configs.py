@@ -90,6 +90,28 @@ MODEL_CONFIGS = {
         norm="layernorm", mlp="mlp", use_bias=True, activation="gelu_tanh",
         tie_embeddings=True, eos_token_id=1, dtype="float32",
     ),
+    # Tiny *study* configs: match the transformers-built checkpoints committed
+    # under checkpoints/ (tools/build_tiny_study_checkpoints.py). These exist
+    # so the full --all study can run through the REAL weights path
+    # (backend_for -> load_checkpoint -> HFTokenizer -> EngineBackend) end to
+    # end without pretrained weights in the environment — the reference's
+    # inference layer was always a real model (phase1_bias_detection.py:180-188),
+    # and results/real_weights/ holds the committed record. Swapping in actual
+    # Llama weights is then a config change, not new code. vocab 512 matches
+    # the committed BPE tokenizer; eos/pad 0 = its <|endoftext|>.
+    "tiny-llama-study": ModelConfig(
+        name="tiny-llama-study", vocab_size=512, num_layers=4, num_heads=4,
+        num_kv_heads=2, d_model=128, d_ff=256, head_dim=32, max_seq_len=1024,
+        eos_token_id=0, pad_token_id=0, dtype="float32",
+        use_flash_attention=False,
+    ),
+    "tiny-gpt2-study": ModelConfig(
+        name="tiny-gpt2-study", vocab_size=512, num_layers=4, num_heads=4,
+        num_kv_heads=4, d_model=128, d_ff=512, head_dim=32, max_seq_len=1024,
+        pos_emb="learned", norm="layernorm", mlp="mlp", use_bias=True,
+        activation="gelu_tanh", tie_embeddings=True, eos_token_id=0,
+        pad_token_id=0, dtype="float32", use_flash_attention=False,
+    ),
     "gpt2-small": ModelConfig(
         name="gpt2-small", vocab_size=50257, num_layers=12, num_heads=12,
         num_kv_heads=12, d_model=768, d_ff=3072, head_dim=64, max_seq_len=1024,

@@ -127,10 +127,12 @@ def group_by(profiles: Sequence[Profile], recs: Dict[str, Dict], attr: str) -> D
 
 
 def measure_demographic_parity(
-    recommendations_by_group: Dict[str, List[List[str]]]
+    recommendations_by_group: Dict[str, List[List[str]]],
+    group_counts_fn=None,
 ) -> Tuple[float, Dict]:
-    """Reference-parity wrapper (``phase1_bias_detection.py:214-218``)."""
-    return M.demographic_parity(recommendations_by_group)
+    """Reference-parity wrapper (``phase1_bias_detection.py:214-218``).
+    ``group_counts_fn`` swaps the count reduction (the dp-psum study path)."""
+    return M.demographic_parity(recommendations_by_group, group_counts_fn)
 
 
 def measure_individual_fairness(
@@ -144,14 +146,19 @@ def measure_individual_fairness(
 def measure_equal_opportunity(
     recommendations_by_group: Dict[str, List[List[str]]],
     qualified: Set[str],
+    group_counts_fn=None,
 ) -> Tuple[float, Dict[str, float]]:
     """Reference-parity wrapper (``phase1_bias_detection.py:241-263``) with
-    canonicalized title matching (fixes the vacuous-1.0 bug, SURVEY.md §8.2)."""
+    canonicalized title matching (fixes the vacuous-1.0 bug, SURVEY.md §8.2).
+    The canonicalization policy lives ONLY here — both the host and the
+    dp-psum reduction (``group_counts_fn``) paths go through this wrapper."""
     canon_groups = {
         g: [canonicalize(r) for r in lists]
         for g, lists in recommendations_by_group.items()
     }
-    return M.equal_opportunity(canon_groups, set(canonicalize(sorted(qualified))))
+    return M.equal_opportunity(
+        canon_groups, set(canonicalize(sorted(qualified))), group_counts_fn
+    )
 
 
 def qualified_movies(data, top_n: int = 10, seed: int = 42) -> List[str]:
@@ -217,14 +224,25 @@ def run_phase1(
     by_gender = group_by(profiles, recs, "gender")
     by_age = group_by(profiles, recs, "age")
 
-    dp_gender, dp_gender_detail = measure_demographic_parity(by_gender)
-    dp_age, dp_age_detail = measure_demographic_parity(by_age)
+    # When the sweep itself ran dp-sharded, the metric reduction stays on
+    # device too (SURVEY §7.2): per-profile count matrices segment-sum locally
+    # and psum over dp; only the [G, V] group summary and final scalars reach
+    # the host. Study-level equality with the host path is asserted in
+    # tests/test_pipeline_sharded.py.
+    mesh = getattr(getattr(backend, "engine", None), "mesh", None)
+    use_device_reduction = mesh is not None and mesh.shape.get("dp", 1) > 1
+    qualified = set(qualified_movies(data, seed=config.random_seed))
+    counts_fn = None
+    if use_device_reduction:
+        from fairness_llm_tpu.metrics.sharded import _mesh_group_counts_fn
+
+        counts_fn = _mesh_group_counts_fn(mesh)
+    dp_gender, dp_gender_detail = measure_demographic_parity(by_gender, counts_fn)
+    dp_age, dp_age_detail = measure_demographic_parity(by_age, counts_fn)
+    eo_score, eo_rates = measure_equal_opportunity(by_gender, qualified, counts_fn)
 
     flat_recs = {pid: r["recommendations"] for pid, r in recs.items()}
     if_score, if_sims = measure_individual_fairness(profiles, flat_recs)
-
-    qualified = set(qualified_movies(data, seed=config.random_seed))
-    eo_score, eo_rates = measure_equal_opportunity(by_gender, qualified)
 
     neutral_flat = [t for r in neutral_recs for t in r["recommendations"]]
     recs_by_gender_flat = {
@@ -249,6 +267,9 @@ def run_phase1(
                 "equal_opportunity uses canonicalized titles (reference's raw-string "
                 "matching yields vacuous 1.0); snsr/snsv are net-new vs reference"
             ),
+            # provenance of the DP/EO reduction: "dp-psum" = on-device over the
+            # mesh the sweep decoded on; "host" = single-device numpy+jit path
+            "metric_reduction": "dp-psum" if use_device_reduction else "host",
         },
         "profiles": [p.to_dict() for p in profiles],
         "recommendations": {

@@ -97,7 +97,13 @@ def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
             k: v for k, v in recs.items()
             if not (isinstance(v, dict) and v.get("error"))
         }
-        if recs:
-            logger.info("resuming from checkpoint %s (%d profiles done)", fname, len(recs))
+        if not recs:
+            # Parses fine but every entry was a contained failure: keep
+            # walking — an older checkpoint may hold valid completed work
+            # (checkpoints are cumulative; this only matters after a
+            # pathological run, but the fallback is free).
+            logger.warning("checkpoint %s has no completed work; trying older", fname)
+            continue
+        logger.info("resuming from checkpoint %s (%d profiles done)", fname, len(recs))
         return recs
     return {}

@@ -39,23 +39,48 @@ def make_sampler(settings: SamplerSettings) -> Callable[[jnp.ndarray, jax.Array]
         return lambda logits, row_rngs: jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def sample(logits: jnp.ndarray, row_rngs: jax.Array) -> jnp.ndarray:
-        x = logits.astype(jnp.float32) / settings.temperature
-        if settings.top_k > 0:
-            kth = jax.lax.top_k(x, settings.top_k)[0][..., -1:]
-            x = jnp.where(x < kth, -jnp.inf, x)
-        if settings.top_p < 1.0:
-            sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_x, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # Keep the smallest prefix with cumulative prob >= top_p (the token
-            # that crosses the threshold stays in — exclusive cumsum test).
-            keep_sorted = (cum - probs) < settings.top_p
-            cutoff = jnp.min(
-                jnp.where(keep_sorted, sorted_x, jnp.inf), axis=-1, keepdims=True
-            )
-            x = jnp.where(x < cutoff, -jnp.inf, x)
+        x = filtered_logits(settings, logits)
         return jax.vmap(
             lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
         )(row_rngs, x)
 
     return sample
+
+
+def filtered_logits(settings: SamplerSettings, logits: jnp.ndarray) -> jnp.ndarray:
+    """Temperature-scale then top-k/top-p filter (-inf on dropped tokens).
+
+    Semantics match transformers' warper pipeline (``TemperatureLogitsWarper``
+    -> ``TopKLogitsWarper`` -> ``TopPLogitsWarper``, the order ``generate``
+    applies them in) so a sweep's sampled outputs are the same *distribution*
+    an HF-served baseline would sample — the reference delegates exactly these
+    knobs to its API (``phase1_bias_detection.py:186-187``); parity is proven
+    in ``tests/test_sampling_parity.py``. Two pinned conventions:
+
+    - top-k ties at the k-th logit: ALL tokens tying the k-th value survive
+      (HF's ``logits < topk(...)[-1]`` convention — may keep more than k).
+    - top-p boundary: the token whose probability crosses the threshold stays
+      (exclusive-cumsum test, = HF's ascending ``cumprobs <= 1-p`` removal).
+      When the boundary token is VALUE-TIED with the next one, we keep all
+      tied tokens (sort-order invariant); HF scatters by sort position and
+      drops an arbitrary subset of the tie. Our kept set is always a superset
+      of HF's, differing only in boundary-tied tokens.
+    """
+    x = logits.astype(jnp.float32) / settings.temperature
+    if settings.top_k > 0:
+        # k >= vocab keeps everything (HF clamps; lax.top_k would reject)
+        k = min(settings.top_k, x.shape[-1])
+        kth = jax.lax.top_k(x, k)[0][..., -1:]
+        x = jnp.where(x < kth, -jnp.inf, x)
+    if settings.top_p < 1.0:
+        sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_x, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (the token
+        # that crosses the threshold stays in — exclusive cumsum test).
+        keep_sorted = (cum - probs) < settings.top_p
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_x, jnp.inf), axis=-1, keepdims=True
+        )
+        x = jnp.where(x < cutoff, -jnp.inf, x)
+    return x

@@ -15,6 +15,7 @@ seq-sharded).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict, Sequence
 
 import flax.linen as nn
@@ -91,6 +92,18 @@ def _score_encoded(
     # on a 16 GB chip); the batch axis shards over dp, so divide by it.
     dp = engine.mesh.shape.get("dp", 1) if engine.mesh is not None else 1
     logits_bytes = batch * s * engine.config.vocab_size * 4 // dp
+    if logits_bytes > LOGITS_BUDGET_BYTES and n <= 8:
+        # No sequence-axis chunking exists: a handful of maximum-length rows
+        # against a huge vocab (qwen2 at 8k x 152k is ~40 GB of f32 logits)
+        # can exceed the budget with nothing left to halve. Warn with the
+        # numbers so an OOM here is diagnosable rather than mysterious.
+        logging.getLogger(__name__).warning(
+            "scoring %d row(s) of bucketed length %d x vocab %d needs ~%.1f GB "
+            "of logits (> %.1f GB budget) and cannot chunk further on the "
+            "batch axis — may OOM; shorten rows or reduce max_seq_len",
+            n, s, engine.config.vocab_size, logits_bytes / 1e9,
+            LOGITS_BUDGET_BYTES / 1e9,
+        )
     if logits_bytes > LOGITS_BUDGET_BYTES and n > 8:
         half = n // 2
         a = _score_encoded(engine, row_tokens[:half], row_valid[:half], prefix_counts[:half])

@@ -140,3 +140,11 @@ def test_resume_falls_back_past_corrupt_checkpoint(tmp_path):
         bad.write_text(payload)
         loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
         assert loaded == {"a": {"recommendations": ["x"], "raw_response": "r"}}, payload
+    # a newest checkpoint that parses but holds ONLY failed entries must also
+    # fall back to older completed work, not return {}
+    bad.write_text(
+        '{"completed": 32, "recommendations": '
+        '{"f": {"recommendations": [], "raw_response": "", "error": "decode_failed"}}}'
+    )
+    loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
+    assert loaded == {"a": {"recommendations": ["x"], "raw_response": "r"}}

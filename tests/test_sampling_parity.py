@@ -1,0 +1,149 @@
+"""Sampling-semantics parity vs transformers' logits processors.
+
+The reference delegates temperature to its API (``phase1_bias_detection.py:
+186-187``); SURVEY.md §7 hard part (b) names sampling parity as load-bearing
+for comparable fairness numbers. These tests compare our sampler's *filtered,
+renormalized distributions* — the deterministic object sampling draws from —
+exactly against transformers' ``TemperatureLogitsWarper`` / ``TopKLogitsWarper``
+/ ``TopPLogitsWarper`` pipeline (the order ``generate`` applies them in), so a
+future real-weights study's sampled outputs are defensibly the same model
+behavior an HF-served baseline would produce.
+
+Conventions pinned here (see ``runtime/sampling.py:filtered_logits``):
+- top-k ties at the k-th logit: both keep ALL tying tokens (may exceed k);
+- top-p boundary: the token crossing the threshold stays in — identical
+  exclusive-cumsum semantics;
+- top-p VALUE-TIED boundary: we keep every tied token (sort-order invariant);
+  HF drops a sort-position-dependent subset. Ours is always a superset,
+  differing only in tokens value-tied with the boundary.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from transformers.generation.logits_process import (
+    TemperatureLogitsWarper,
+    TopKLogitsWarper,
+    TopPLogitsWarper,
+)
+
+from fairness_llm_tpu.runtime.sampling import SamplerSettings, filtered_logits
+
+
+def _hf_filtered(logits: np.ndarray, t: float, k: int, p: float) -> np.ndarray:
+    scores = torch.tensor(logits, dtype=torch.float32)
+    ids = torch.zeros((scores.shape[0], 1), dtype=torch.long)
+    scores = TemperatureLogitsWarper(t)(ids, scores)
+    if k > 0:
+        scores = TopKLogitsWarper(k)(ids, scores)
+    if p < 1.0:
+        scores = TopPLogitsWarper(p)(ids, scores)
+    return scores.numpy()
+
+
+def _ours_filtered(logits: np.ndarray, t: float, k: int, p: float) -> np.ndarray:
+    return np.asarray(
+        filtered_logits(SamplerSettings(temperature=t, top_k=k, top_p=p), logits)
+    )
+
+
+def _dist(filtered: np.ndarray) -> np.ndarray:
+    """Renormalized distribution over the kept set (-inf -> prob 0)."""
+    x = np.asarray(filtered, np.float64)
+    x = x - np.max(x, axis=-1, keepdims=True)
+    prob = np.exp(x)
+    return prob / prob.sum(axis=-1, keepdims=True)
+
+
+# temperature-only, k-only (incl. k=1 and k>=V), p-only (incl. aggressive
+# p=0.3), combined k+p, and near-1 p exercising the cumsum tail.
+GRID = [
+    (0.7, 0, 1.0),
+    (1.3, 10, 1.0),
+    (1.0, 1, 1.0),
+    (1.0, 500, 1.0),
+    (0.7, 0, 0.9),
+    (0.9, 0, 0.3),
+    (0.8, 17, 0.85),
+    (0.25, 5, 0.999),
+]
+
+
+@pytest.mark.parametrize("t,k,p", GRID)
+def test_filtered_distribution_parity(t, k, p):
+    rng = np.random.default_rng(0)
+    logits = (rng.normal(size=(4, 101)) * 3).astype(np.float32)
+    ours = _ours_filtered(logits, t, k, p)
+    theirs = _hf_filtered(logits, t, k, p)
+    np.testing.assert_array_equal(np.isfinite(ours), np.isfinite(theirs))
+    np.testing.assert_allclose(_dist(ours), _dist(theirs), atol=1e-6)
+
+
+def test_topk_tie_at_kth_logit():
+    """k=2 with an exact tie at the 2nd value: both samplers keep all three
+    tying-or-above tokens (the '< k-th value' convention)."""
+    logits = np.array([[3.0, 2.0, 2.0, 1.0, 0.5]], np.float32)
+    ours = _ours_filtered(logits, 1.0, 2, 1.0)
+    theirs = _hf_filtered(logits, 1.0, 2, 1.0)
+    assert np.isfinite(ours[0]).tolist() == [True, True, True, False, False]
+    np.testing.assert_array_equal(np.isfinite(ours), np.isfinite(theirs))
+    np.testing.assert_allclose(_dist(ours), _dist(theirs), atol=1e-6)
+
+
+def test_topp_boundary_token_kept():
+    """probs ~ [0.5, 0.3, 0.2], p = 0.6: the 0.3 token CROSSES the threshold
+    and must stay (exclusive-cumsum convention); the 0.2 token is dropped.
+    Both implementations agree."""
+    logits = np.log(np.array([[0.5, 0.3, 0.2]], np.float32))
+    ours = _ours_filtered(logits, 1.0, 0, 0.6)
+    theirs = _hf_filtered(logits, 1.0, 0, 0.6)
+    assert np.isfinite(ours[0]).tolist() == [True, True, False]
+    np.testing.assert_array_equal(np.isfinite(ours), np.isfinite(theirs))
+    np.testing.assert_allclose(_dist(ours), _dist(theirs), atol=1e-6)
+
+
+def test_topp_value_tied_boundary_is_superset():
+    """probs [0.5, 0.25, 0.25], p = 0.75: the boundary token is value-tied
+    with the next. We keep BOTH tied tokens (permutation-invariant); HF's
+    positional scatter may drop one (rounding decides which side of the
+    threshold the tie's cumsum lands on). Pinned property: our kept set is a
+    superset of HF's, and any extra tokens are exact value-ties of our
+    smallest kept logit."""
+    logits = np.log(np.array([[0.5, 0.25, 0.25]], np.float32))
+    ours = _ours_filtered(logits, 1.0, 0, 0.75)
+    theirs = _hf_filtered(logits, 1.0, 0, 0.75)
+    ours_kept = set(np.flatnonzero(np.isfinite(ours[0])))
+    hf_kept = set(np.flatnonzero(np.isfinite(theirs[0])))
+    assert ours_kept == {0, 1, 2}  # both tied tokens survive
+    assert hf_kept <= ours_kept
+    boundary = min(ours[0][i] for i in ours_kept)
+    for extra in ours_kept - hf_kept:
+        assert ours[0][extra] == boundary
+
+
+def test_sampled_tokens_follow_filtered_distribution():
+    """End to end: tokens drawn by make_sampler land only on the kept set and
+    match its renormalized distribution (chi-square-loose bound), tying the
+    parity proof above to what the decode loop actually samples."""
+    import jax
+
+    from fairness_llm_tpu.runtime.sampling import make_sampler
+
+    settings = SamplerSettings(temperature=0.8, top_k=4, top_p=0.9)
+    rng = np.random.default_rng(3)
+    logits = (rng.normal(size=(1, 16)) * 2).astype(np.float32)
+    kept = np.isfinite(_ours_filtered(logits, 0.8, 4, 0.9)[0])
+    expect = _dist(_ours_filtered(logits, 0.8, 4, 0.9))[0]
+
+    sample = make_sampler(settings)
+    draws = 4000
+    keys = jax.vmap(jax.random.key)(np.arange(draws, dtype=np.uint32))
+    toks = np.asarray(
+        jax.vmap(lambda k: sample(logits, k[None]))(keys)
+    ).ravel()
+    assert set(toks) <= set(np.flatnonzero(kept))
+    freq = np.bincount(toks, minlength=16) / draws
+    np.testing.assert_allclose(freq, expect, atol=0.03)

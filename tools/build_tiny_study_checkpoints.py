@@ -1,0 +1,281 @@
+"""Build the tiny *real-architecture* study checkpoints under checkpoints/.
+
+Purpose (VERDICT r2 item 1): the environment has no pretrained weights and no
+egress, so the study's *numbers* on real Llama are blocked — but the *path* is
+not. This script produces checkpoints with ``transformers`` itself (the same
+machinery ``tests/test_hf_parity.py`` trusts as ground truth) and — so the
+committed study record is non-vacuous — FINE-TUNES them (torch, CPU, seeded)
+to speak the study's format:
+
+- a byte-level BPE tokenizer trained to exactly 512 ids on the study's own
+  prompt surfaces, saved per-checkpoint so ``backend_for`` picks it up via
+  ``tokenizer_config.json``;
+- ``tiny-llama-study``: LlamaForCausalLM (RoPE, GQA kv=2, SwiGLU, untied head);
+- ``tiny-gpt2-study``: GPT2LMHeadModel (learned positions, LayerNorm, fused
+  QKV Conv1D, tied head);
+- both distilled from the deterministic ``SimulatedRecommender`` teacher —
+  numbered-list recommendations (with a demographic-dependent bias signal and
+  a weaker-bias response to fairness-instruction prompts, so phases 1 and 3
+  measure something), listwise rankings, and pairwise A/B answers. The two
+  models get teachers with different bias levels, so phase 2's cross-model
+  comparison is non-vacuous.
+
+Checkpoints are safetensors, ~6 MB each — committed. ``results/real_weights/``
+is produced by running the CLI against these with ``--weights-dir
+checkpoints``: the exact provenance chain (``backend_for -> load_checkpoint ->
+HFTokenizer -> EngineBackend``) a real Llama checkpoint would take; the
+reference's inference layer was always a real model
+(``phase1_bias_detection.py:180-188``).
+
+Run from the repo root:  python tools/build_tiny_study_checkpoints.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+VOCAB = 512
+OUT_DIR = "checkpoints"
+SEQ_CAP = 768
+# Teacher bias per model: distinct levels keep the cross-model phase-2
+# comparison non-vacuous (the reference compares gpt-3.5 vs gpt-4 the same way).
+TEACHER_BIAS = {"tiny-llama-study": 0.9, "tiny-gpt2-study": 0.35}
+EPOCHS = 30
+LR = 1e-3
+BATCH = 8
+
+
+def study_surfaces():
+    """The study's own data/prompt objects, built once."""
+    from fairness_llm_tpu.config import default_config
+    from fairness_llm_tpu.data import (
+        create_base_preferences,
+        create_profile_grid,
+        load_movielens,
+    )
+    from fairness_llm_tpu.data.ranking import create_synthetic_ranking_data
+
+    config = default_config()
+    data = load_movielens(config.data_dir, seed=config.random_seed)
+    prefs = create_base_preferences(data, seed=config.random_seed)
+    # More profiles than the study uses (6/combo vs 3) — the extra are plain
+    # augmentation; the study's exact prompts are a subset, which is the point
+    # of distillation (the model should do well on them).
+    profiles = create_profile_grid(prefs, config, 6)
+    items = create_synthetic_ranking_data(num_items=12, seed=config.random_seed)
+    return config, data, prefs, profiles, items
+
+
+def build_corpus(data, profiles, items) -> list:
+    """Prompt-shaped tokenizer-training text from the pipeline's surfaces."""
+    from fairness_llm_tpu.pipeline.prompts import (
+        fairness_aware_prompt,
+        listwise_prompt,
+        pairwise_prompt,
+        recommendation_prompt,
+    )
+
+    corpus = [recommendation_prompt(p) for p in profiles]
+    corpus += [fairness_aware_prompt(p) for p in profiles[:5]]
+    corpus.append(listwise_prompt(items))
+    corpus += [pairwise_prompt(items[0], items[1]), pairwise_prompt(items[2], items[3])]
+    corpus += list(data.titles)
+    # numbered-list shapes the parsers expect, so digits/periods get merges
+    corpus += [f"{i}. {t}" for i, t in enumerate(data.titles[:40], 1)]
+    return corpus
+
+
+def build_tokenizer(corpus):
+    import tokenizers
+    from tokenizers import decoders
+    from tokenizers import models as tok_models
+    from tokenizers import pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = tokenizers.Tokenizer(tok_models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    tok.train_from_iterator(
+        corpus,
+        trainers.BpeTrainer(vocab_size=VOCAB, special_tokens=["<|endoftext|>"]),
+    )
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok, eos_token="<|endoftext|>")
+    got = len(fast)
+    if got != VOCAB:
+        raise SystemExit(
+            f"BPE trained to {got} ids, need exactly {VOCAB} (ModelConfig vocab "
+            "is static) — enlarge the corpus in build_corpus()"
+        )
+    assert fast.eos_token_id == 0  # ModelConfig eos/pad_token_id pin this
+    return fast
+
+
+def build_models():
+    import torch
+    import transformers
+
+    torch.manual_seed(0)
+    llama = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=VOCAB, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=1024, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False, attention_bias=False,
+        mlp_bias=False, eos_token_id=0, pad_token_id=0,
+    ))
+    torch.manual_seed(1)
+    gpt2 = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=VOCAB, n_positions=1024, n_embd=128, n_layer=4, n_head=4,
+        activation_function="gelu_new", layer_norm_epsilon=1e-5,
+        eos_token_id=0, pad_token_id=0,
+    ))
+    return {"tiny-llama-study": llama, "tiny-gpt2-study": gpt2}
+
+
+def teacher_pairs(config, data, profiles, items, bias: float, seed: int):
+    """(prompt, completion) distillation pairs from the simulated teacher."""
+    from fairness_llm_tpu.pipeline.backends import SimulatedRecommender
+    from fairness_llm_tpu.pipeline.prompts import (
+        fairness_aware_prompt,
+        listwise_prompt,
+        pairwise_prompt,
+        recommendation_prompt,
+    )
+
+    rec_teacher = SimulatedRecommender(
+        data.titles, seed=config.random_seed, bias=bias
+    )
+    rank_teacher = SimulatedRecommender(
+        [it.text for it in items], seed=config.random_seed, bias=bias,
+        catalog_groups=[it.protected_attribute for it in items],
+    )
+    pairs = []
+    # recommendation prompts — plain AND fairness-instructed (the teacher's
+    # mitigation response is what gives phase 3 a measurable bias reduction)
+    plain = [recommendation_prompt(p) for p in profiles]
+    fair = [fairness_aware_prompt(p) for p in profiles]
+    for pr, out in zip(plain, rec_teacher.generate(plain, seed=seed)):
+        pairs.append((pr, out))
+    for pr, out in zip(fair, rec_teacher.generate(fair, seed=seed)):
+        pairs.append((pr, out))
+    # listwise rankings over the study's item set, several sampled orders
+    lw = [listwise_prompt(items)] + [
+        listwise_prompt(items, query=f"topic {q}") for q in range(5)
+    ]
+    lw = lw * 4  # repetition with distinct teacher draws
+    for i, (pr, out) in enumerate(zip(lw, rank_teacher.generate(lw, seed=seed, keys=[f"lw{i}" for i in range(len(lw))]))):
+        pairs.append((pr, out))
+    # pairwise comparisons over all ordered item pairs
+    pw = [
+        pairwise_prompt(items[a], items[b])
+        for a in range(len(items)) for b in range(len(items)) if a != b
+    ]
+    for pr, out in zip(pw, rank_teacher.generate(pw, seed=seed)):
+        pairs.append((pr, out))
+    return pairs
+
+
+def finetune(model, tokenizer, pairs, seed: int, epochs: int = EPOCHS):
+    """Seeded CPU fine-tune: LM loss on the completion (+eos) only."""
+    import torch
+
+    rows = []
+    for prompt, completion in pairs:
+        p_ids = tokenizer.encode(prompt)
+        c_ids = tokenizer.encode(completion) + [tokenizer.eos_token_id]
+        ids = (p_ids + c_ids)[:SEQ_CAP]
+        labels = ([-100] * len(p_ids) + c_ids)[:SEQ_CAP]
+        rows.append((ids, labels))
+
+    g = torch.Generator().manual_seed(seed)
+    torch.manual_seed(seed)
+    model.train()
+    opt = torch.optim.AdamW(model.parameters(), lr=LR)
+    steps = 0
+    for epoch in range(epochs):
+        order = torch.randperm(len(rows), generator=g).tolist()
+        for start in range(0, len(order), BATCH):
+            batch = [rows[i] for i in order[start : start + BATCH]]
+            width = max(len(ids) for ids, _ in batch)
+            input_ids = torch.zeros(len(batch), width, dtype=torch.long)
+            labels = torch.full((len(batch), width), -100, dtype=torch.long)
+            attn = torch.zeros(len(batch), width, dtype=torch.long)
+            for i, (ids, lab) in enumerate(batch):
+                input_ids[i, : len(ids)] = torch.tensor(ids)
+                labels[i, : len(lab)] = torch.tensor(lab)
+                attn[i, : len(ids)] = 1
+            out = model(input_ids=input_ids, attention_mask=attn, labels=labels)
+            out.loss.backward()
+            torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+            opt.step()
+            opt.zero_grad()
+            steps += 1
+        if epoch % 5 == 0 or epoch == epochs - 1:
+            print(f"  epoch {epoch}: loss {out.loss.item():.4f}")
+    model.eval()
+    return steps
+
+
+def sanity_sample(model, tokenizer, prompt: str) -> str:
+    """Greedy sample to eyeball format-following after training."""
+    import torch
+
+    ids = torch.tensor([tokenizer.encode(prompt)[-SEQ_CAP:]])
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=64, do_sample=False,
+            pad_token_id=0, eos_token_id=0,
+        )
+    return tokenizer.decode(out[0, ids.shape[1]:], skip_special_tokens=True)
+
+
+def main() -> int:
+    sys.path.insert(0, os.getcwd())
+    import transformers
+
+    from fairness_llm_tpu.pipeline.parsing import parse_numbered_list
+    from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+
+    config, data, prefs, profiles, items = study_surfaces()
+    corpus = build_corpus(data, profiles, items)
+    tokenizer = build_tokenizer(corpus)
+    for name, model in build_models().items():
+        bias = TEACHER_BIAS[name]
+        seed = 0 if "llama" in name else 1
+        pairs = teacher_pairs(config, data, profiles, items, bias, seed)
+        print(f"{name}: fine-tuning on {len(pairs)} teacher pairs (bias={bias})")
+        steps = finetune(model, tokenizer, pairs, seed)
+        sample = sanity_sample(model, tokenizer, recommendation_prompt(profiles[0]))
+        parsed = parse_numbered_list(sample)
+        print(f"  greedy sample parses to {len(parsed)} titles: {parsed[:3]}")
+
+        path = os.path.join(OUT_DIR, name)
+        os.makedirs(path, exist_ok=True)
+        model.save_pretrained(path, safe_serialization=True)
+        tokenizer.save_pretrained(path)
+        with open(os.path.join(path, "PROVENANCE.json"), "w") as f:
+            json.dump(
+                {
+                    "builder": "tools/build_tiny_study_checkpoints.py",
+                    "transformers_version": transformers.__version__,
+                    "seed": seed,
+                    "teacher_bias": bias,
+                    "finetune": {"epochs": EPOCHS, "lr": LR, "batch": BATCH,
+                                 "steps": steps},
+                    "tokenizer": "byte-level BPE, vocab 512, trained on the "
+                                 "pipeline's own prompt surfaces",
+                    "purpose": "prove the real-weights study path end to end "
+                               "(VERDICT r2 item 1); distilled from the "
+                               "SimulatedRecommender teacher, NOT a "
+                               "pretrained model",
+                },
+                f, indent=1,
+            )
+        n_params = sum(p.numel() for p in model.parameters())
+        print(f"{name}: {n_params} params -> {path}/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
