@@ -38,15 +38,22 @@ MAX_NEW_TOKENS = 128
 V5E_HBM_GBPS = 819.0  # v5e spec HBM bandwidth — the decode roofline reference
 
 
-def decode_step_bytes(config, stats, param_dtype_bytes: int) -> int:
+def decode_step_bytes(config, stats) -> int:
     """HBM bytes one decode step must stream (the decode-time roofline model).
 
     Per step: every parameter once (matmuls touch all weights), each row's KV
     cache (its remainder-prompt + generated slots), and the shared prefix KV
     once per step (read once for the whole batch — the prefix-cache win).
+
+    Param width: the COMPUTE dtype, not the storage dtype — the round-3
+    device trace shows XLA hoists the f32->bf16 cast of a bf16-config
+    model's f32-stored tree out of the decode loop (the loop's slice-start
+    DMAs stream bf16 slices), so each step streams 2 bytes/param even when
+    storage is f32. Using the storage width overstated step bytes ~25% and
+    inflated achieved_hbm_gbps accordingly.
     """
-    params = config.approx_param_count * param_dtype_bytes
     model_item = 2 if config.dtype == "bfloat16" else 4
+    params = config.approx_param_count * model_item
     if config.kv_cache_quant:
         # int8 values + the per-(slot, head) f32 scale the step also reads —
         # same accounting as parallel/sharding.per_device_kv_cache_bytes.
@@ -402,7 +409,7 @@ def _run() -> None:
     profiles_per_sec = len(prompts) / best  # single chip: total == per-chip
     tokens_per_sec = len(prompts) * MAX_NEW_TOKENS / best
     sweep_stats = out.stats
-    step_bytes = decode_step_bytes(config, sweep_stats, engine.param_itemsize)
+    step_bytes = decode_step_bytes(config, sweep_stats)
     achieved_gbps = step_bytes * MAX_NEW_TOKENS / best / 1e9
 
     # Free the phase-1 engine (params + compiled big-batch caches) before the

@@ -59,15 +59,22 @@ def _bucket_len(n: int, multiple: int = 64) -> int:
 
 def _token_lcp(rows) -> int:
     """Longest common token prefix across rows, capped so that every row
-    keeps at least one non-prefix token."""
+    keeps at least one non-prefix token. Vectorized: the python-loop version
+    profiled at ~25 ms per sweep call (5% of the whole decode wall) on 45
+    ~900-token rows."""
     if not rows:
         return 0
     limit = min(len(r) for r in rows) - 1
-    common = 0
-    first = rows[0]
-    while common < limit and all(r[common] == first[common] for r in rows):
-        common += 1
-    return common
+    if limit <= 0:
+        return 0
+    first = np.asarray(rows[0][:limit], dtype=np.int64)
+    agree = np.ones(limit, dtype=bool)
+    for r in rows[1:]:
+        agree &= first == np.asarray(r[:limit], dtype=np.int64)
+        if not agree[0]:
+            return 0
+    mismatch = np.flatnonzero(~agree)
+    return int(mismatch[0]) if mismatch.size else limit
 
 
 def _bucket_batch(n: int, mesh: Optional[jax.sharding.Mesh] = None) -> int:
@@ -322,6 +329,14 @@ class DecodeEngine:
             )
         prompt_budget = self.config.max_seq_len - max_new
         n = len(prompts)
+        if n == 0:
+            # An empty chunk (e.g. a fully-resumed sweep) must not compile and
+            # run an all-pad-rows device program just to discard it.
+            return GenerateOutput(
+                texts=[], tokens=np.zeros((0, max_new), np.int32), steps=max_new,
+                stats={"batch": 0, "prompt_len": 0, "prefix_len": 0,
+                       "cache_slots": 0},
+            )
 
         # Shared-prefix decode: the counterfactual sweep's prompts are
         # near-identical, so their longest common TOKEN prefix is most of the
