@@ -240,6 +240,9 @@ def run_phase1(
     dp_gender, dp_gender_detail = measure_demographic_parity(by_gender, counts_fn)
     dp_age, dp_age_detail = measure_demographic_parity(by_age, counts_fn)
     eo_score, eo_rates = measure_equal_opportunity(by_gender, qualified, counts_fn)
+    # Age is the second sensitive axis everywhere else (DP, SNSR/SNSV); give
+    # EO the same both-axes treatment (the reference measures gender only).
+    eo_age, eo_age_rates = measure_equal_opportunity(by_age, qualified, counts_fn)
 
     flat_recs = {pid: r["recommendations"] for pid, r in recs.items()}
     if_score, if_sims = measure_individual_fairness(profiles, flat_recs)
@@ -281,6 +284,7 @@ def run_phase1(
             "demographic_parity_age": {"score": dp_age, **dp_age_detail},
             "individual_fairness": {"score": if_score, "num_pairs": len(if_sims)},
             "equal_opportunity": {"score": eo_score, "group_scores": eo_rates},
+            "equal_opportunity_age": {"score": eo_age, "group_scores": eo_age_rates},
             "snsr_snsv": {"snsr": snsr, "snsv": snsv, "group_similarities": sns_sims},
             "snsr_snsv_age": {
                 "snsr": snsr_age, "snsv": snsv_age, "group_similarities": sns_sims_age,
@@ -306,6 +310,8 @@ def print_phase1_summary(results: Dict) -> None:
     print(f"demographic parity (age):    {m['demographic_parity_age']['score']:.4f}")
     print(f"individual fairness:         {m['individual_fairness']['score']:.4f}")
     print(f"equal opportunity:           {m['equal_opportunity']['score']:.4f}")
+    if "equal_opportunity_age" in m:
+        print(f"equal opportunity (age):     {m['equal_opportunity_age']['score']:.4f}")
     print(f"SNSR/SNSV (gender): {m['snsr_snsv']['snsr']:.4f} / {m['snsr_snsv']['snsv']:.4f}")
     if "snsr_snsv_age" in m:
         print(f"SNSR/SNSV (age):    {m['snsr_snsv_age']['snsr']:.4f} / {m['snsr_snsv_age']['snsv']:.4f}")

@@ -133,8 +133,10 @@ class DecodeEngine:
             param_dtype = (
                 jnp.bfloat16 if (model_config.dtype == "bfloat16" and big) else jnp.float32
             )
-        # The resolved storage width, for byte-accounting callers (bench.py's
-        # roofline model must not re-derive this policy and drift).
+        # The resolved STORAGE width. Note for byte accounting: the decode
+        # loop streams params at the COMPUTE width regardless (XLA hoists the
+        # storage->compute cast out of the loop — see docs/PERFORMANCE.md
+        # round 3), so roofline models should use config.dtype, not this.
         self.param_itemsize = 2 if param_dtype == jnp.bfloat16 else 4
         if self.mesh is not None:
             pb = shd.per_device_param_bytes(

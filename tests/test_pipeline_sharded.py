@@ -57,7 +57,8 @@ def test_phase1_study_identical_sharded_vs_unsharded(engines, tmp_path):
     # ...and both reductions produce identical fairness numbers.
     m1, m2 = r1["metrics"], r2["metrics"]
     for key in ("demographic_parity_gender", "demographic_parity_age",
-                "individual_fairness", "equal_opportunity"):
+                "individual_fairness", "equal_opportunity",
+                "equal_opportunity_age"):
         assert abs(m1[key]["score"] - m2[key]["score"]) < ATOL, key
     assert abs(m1["snsr_snsv"]["snsr"] - m2["snsr_snsv"]["snsr"]) < ATOL
     # EO per-group rates and DP divergence details agree too

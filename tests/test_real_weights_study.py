@@ -116,7 +116,8 @@ def test_phase1_rerun_matches_committed_record(config, tmp_path):
         assert got["recommendations"][pid]["raw_response"] == rec["raw_response"], pid
     gm, wm = got["metrics"], want["metrics"]
     for key in ("demographic_parity_gender", "demographic_parity_age",
-                "individual_fairness", "equal_opportunity"):
+                "individual_fairness", "equal_opportunity",
+                "equal_opportunity_age"):
         assert gm[key]["score"] == pytest.approx(wm[key]["score"], abs=1e-6), key
     assert gm["snsr_snsv"]["snsr"] == pytest.approx(wm["snsr_snsv"]["snsr"], abs=1e-6)
 
