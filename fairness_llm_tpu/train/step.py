@@ -7,8 +7,10 @@ TPU mapping (scaling-book recipe):
 - params/optimizer state sharded over ``tp`` via the same logical-axis rules
   the decode path uses (``parallel/sharding.py``) — grads and Adam moments
   inherit the layout, so memory scales down with the mesh.
-- ``jax.checkpoint`` (remat) on each block trades FLOPs for HBM when
-  activations don't fit.
+- ``jax.checkpoint`` (remat) over the forward trades FLOPs for HBM when
+  activations don't fit (whole-forward policy: maximal memory saving,
+  maximal recompute — the right end of the trade when the alternative is
+  not fitting at all).
 
 Everything under one ``jax.jit``; no data-dependent Python control flow.
 """

@@ -122,6 +122,31 @@ def test_phase1_rerun_matches_committed_record(config, tmp_path):
     assert gm["snsr_snsv"]["snsr"] == pytest.approx(wm["snsr_snsv"]["snsr"], abs=1e-6)
 
 
+def test_phase2_rerun_matches_committed_record(config, tmp_path):
+    """Cross-model phase 2 (listwise + pairwise + likelihood-scored) through
+    the real-weights engines must reproduce the committed per-model scores."""
+    import dataclasses
+
+    from fairness_llm_tpu.pipeline.phase2 import run_phase2
+
+    config = dataclasses.replace(config, results_dir=str(tmp_path))
+    got = run_phase2(
+        config, models=["tiny-llama-study", "tiny-gpt2-study"],
+        num_items=12, num_comparisons=8, num_queries=2, save=False,
+    )
+    want = _load("phase2", "phase2_results.json")
+    for name, wm in want["model_results"].items():
+        gm = got["model_results"][name]
+        for method in ("listwise", "pairwise", "scored"):
+            assert gm[method]["exposure_ratio"] == pytest.approx(
+                wm[method]["exposure_ratio"], abs=1e-6
+            ), (name, method)
+            assert gm[method]["ndcg_per_group"] == pytest.approx(
+                wm[method]["ndcg_per_group"], abs=1e-6
+            ), (name, method)
+        assert gm["parse_failures"] == wm["parse_failures"]
+
+
 def test_phase3_model_conditional_rerun_matches_record(config, tmp_path):
     """The model-conditional conformal path (scoring -> confidence mapping ->
     thresholds -> filter -> measurement) end to end on real weights must

@@ -74,3 +74,33 @@ def test_sharded_dp_matches_host_metric(eight_device_mesh):
     )
     host_score, _ = demographic_parity(recs_by_group)
     np.testing.assert_allclose(float(score), host_score, atol=1e-5)
+
+
+def test_mesh_group_counts_fn_randomized(eight_device_mesh):
+    """The group_counts_fn hook (what phase 1 actually wires in): DP and EO
+    through the psum reduction == host wrappers on randomized rec lists of
+    UNEVEN lengths and group sizes (incl. an empty group)."""
+    from fairness_llm_tpu.metrics import equal_opportunity
+    from fairness_llm_tpu.metrics.sharded import _mesh_group_counts_fn
+
+    rng = np.random.default_rng(7)
+    items = [f"title {i}" for i in range(30)]
+    recs_by_group = {"a": [], "b": [], "c": [], "empty": []}
+    for gi, g in enumerate(("a", "b", "c")):
+        for _ in range(int(rng.integers(1, 7))):
+            k = int(rng.integers(1, 12))
+            recs_by_group[g].append(
+                [items[int(j)] for j in rng.integers(gi * 3, 30, size=k)]
+            )
+    relevant = {items[i] for i in range(0, 30, 4)}
+
+    fn = _mesh_group_counts_fn(eight_device_mesh)
+    dp_s, det_s = demographic_parity(recs_by_group, group_counts_fn=fn)
+    dp_h, det_h = demographic_parity(recs_by_group)
+    np.testing.assert_allclose(dp_s, dp_h, atol=1e-5)
+    assert det_s["divergences"] == pytest.approx(det_h["divergences"], abs=1e-5)
+
+    eo_s = equal_opportunity(recs_by_group, relevant, group_counts_fn=fn)
+    eo_h = equal_opportunity(recs_by_group, relevant)
+    np.testing.assert_allclose(eo_s[0], eo_h[0], atol=1e-5)
+    assert eo_s[1] == pytest.approx(eo_h[1], abs=1e-5)
