@@ -208,6 +208,23 @@ def main(argv=None) -> int:
 
                     generate_phase3_figure(p3, f"{config.results_dir}/visualizations")
 
+    if config.profile_trace_dir:
+        # Terminal-friendly device-op breakdown of the captured trace — the
+        # analysis TensorBoard would show, without leaving the shell.
+        try:
+            from fairness_llm_tpu.utils.profiling import summarize_trace
+
+            # One parse of all planes; prefer the TPU device planes and fall
+            # back to host planes on CPU-only runs.
+            summaries = summarize_trace(
+                config.profile_trace_dir, top_k=8, device_filter=""
+            )
+            tpu = [s for s in summaries if "TPU" in s.device]
+            for summary in tpu or summaries:
+                print("\n" + summary.format())
+        except Exception as e:  # noqa: BLE001 — diagnostics must not fail the run
+            logger.warning("trace summary unavailable: %s", e)
+
     print("\n" + "=" * 60)
     print("RUN COMPLETE")
     for name, dt in timings.items():

@@ -5,14 +5,23 @@ phase timers at ``main.py:87-125``; this adds real device traces).
 ``config.profile_trace_dir`` is set — the trace opens in XProf/TensorBoard and
 shows per-op device time, HBM traffic, and fusion boundaries. Zero overhead
 when unset (no-op context).
+
+``summarize_trace(trace_dir)`` aggregates a captured trace's device events
+per op WITHOUT TensorBoard — the terminal-friendly analysis that produced
+the round-3 decode-step breakdown (docs/PERFORMANCE.md): total device time,
+event counts, and the top ops by accumulated duration.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
+import dataclasses
+import glob
 import logging
+import os
 import time
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -27,6 +36,138 @@ def maybe_trace(trace_dir: Optional[str], label: str = "region") -> Iterator[Non
     logger.info("profiling %s -> %s", label, trace_dir)
     with jax.profiler.trace(trace_dir):
         yield
+
+
+@dataclasses.dataclass
+class TraceSummary:
+    """Per-device aggregation of one ``jax.profiler.trace`` capture."""
+
+    device: str
+    total_ms: float
+    num_events: int
+    top_ops: List[Tuple[str, float, int]]  # (op name, total ms, count)
+
+    def format(self, width: int = 80) -> str:
+        lines = [
+            f"{self.device}: {self.total_ms:.1f} ms device time, "
+            f"{self.num_events} events"
+        ]
+        for name, ms, cnt in self.top_ops:
+            lines.append(f"  {ms:9.2f} ms  x{cnt:6d}  {name[:width]}")
+        return "\n".join(lines)
+
+
+def _xplane_proto():
+    """The XSpace proto, importable from whichever package ships it. The
+    generated module may need pure-python protobuf parsing
+    (PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python) with some installed
+    protobuf majors — callers get a clear error naming the knob."""
+    for mod in (
+        "tensorflow.tsl.profiler.protobuf.xplane_pb2",
+        "tsl.profiler.protobuf.xplane_pb2",
+        "tensorflow.core.profiler.protobuf.xplane_pb2",
+    ):
+        try:
+            import importlib
+
+            return importlib.import_module(mod)
+        except Exception:  # noqa: BLE001 — try the next location
+            continue
+    raise ImportError(
+        "no xplane_pb2 module available (needs tensorflow's tsl profiler "
+        "protos); if import fails with a protobuf Descriptor error, set "
+        "PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python"
+    )
+
+
+def summarize_trace(
+    trace_dir: str, top_k: int = 15, device_filter: str = "TPU",
+    latest_only: bool = False,
+) -> List[TraceSummary]:
+    """Aggregate every capture under ``trace_dir`` by device op.
+
+    A multi-phase run (``--all --trace-dir``) writes one timestamped capture
+    per phase, and a multi-host run one file per host — each becomes its own
+    ``TraceSummary``, labeled ``<capture>/<file>: <plane>`` so phases/hosts
+    aren't conflated (``latest_only=True`` restricts to the newest capture).
+    ``device_filter`` is a plane-name substring; "" for all planes including
+    host. Event durations sum per op name across a capture — for a decode
+    loop that means per-step ops show up with count == steps executed, which
+    is how the round-3 analysis attributed the 2.12 ms/step to its
+    slice/copy/matmul parts.
+    """
+    pbs = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+    if not pbs:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    if latest_only:
+        pbs = pbs[-1:]
+    xplane_pb2 = _xplane_proto()
+
+    out: List[TraceSummary] = []
+    for pb in pbs:
+        xs = xplane_pb2.XSpace()
+        with open(pb, "rb") as f:
+            xs.ParseFromString(f.read())
+        label = os.path.join(
+            os.path.basename(os.path.dirname(pb)),
+            os.path.basename(pb).replace(".xplane.pb", ""),
+        )
+        out.extend(_summarize_planes(xs, label, top_k, device_filter))
+    return out
+
+
+def _summarize_planes(xs, label: str, top_k: int, device_filter: str) -> List[TraceSummary]:
+    out: List[TraceSummary] = []
+    for plane in xs.planes:
+        if device_filter and device_filter not in plane.name:
+            continue
+        meta = {k: v.name for k, v in plane.event_metadata.items()}
+        # A device plane carries NESTED aggregation levels as separate lines:
+        # "XLA Modules" (one event per program execution), "XLA Ops" (the ops
+        # inside, where a while-loop op's span contains its body's ops), and
+        # "Async XLA Ops" (DMA copies overlapping compute). Summing across
+        # lines double-counts, so: total device time comes from the Modules
+        # line (true busy time), per-op rows from the exact Ops line (a loop
+        # op's row includes its children — it reads as "time under this op").
+        # Host planes (nested TraceMe threads) have no such levels; their
+        # totals are "sum of event durations", not wall time.
+        by_name = {l.name: l for l in plane.lines}
+        op_line = by_name.get("XLA Ops")
+        if op_line is not None:
+            lines = [op_line]
+        elif "XLA Modules" in by_name:
+            # Device plane without op-level recording (reduced verbosity):
+            # fall back to module granularity ONLY — mixing in async-copy
+            # lines would double-count against the module spans.
+            lines = [by_name["XLA Modules"]]
+        else:
+            lines = list(plane.lines)  # host plane: nested TraceMe threads
+        totals: collections.Counter = collections.Counter()
+        counts: collections.Counter = collections.Counter()
+        for line in lines:
+            for ev in line.events:
+                name = meta.get(ev.metadata_id, "?")
+                totals[name] += ev.duration_ps / 1e9  # ps -> ms
+                counts[name] += 1
+        if not totals:
+            continue
+        if "XLA Modules" in by_name:
+            total_ms = sum(ev.duration_ps / 1e9 for ev in by_name["XLA Modules"].events)
+        else:
+            total_ms = sum(totals.values())
+        top = [
+            (name, round(ms, 3), counts[name])
+            for name, ms in totals.most_common(top_k)
+        ]
+        out.append(
+            TraceSummary(
+                device=f"{label}: {plane.name}",
+                total_ms=round(total_ms, 2),
+                num_events=sum(counts.values()),
+                top_ops=top,
+            )
+        )
+    return out
 
 
 @contextlib.contextmanager

@@ -148,3 +148,26 @@ def test_resume_falls_back_past_corrupt_checkpoint(tmp_path):
     )
     loaded = R.load_latest_checkpoint(str(tmp_path), "phase1")
     assert loaded == {"a": {"recommendations": ["x"], "raw_response": "r"}}
+
+
+def test_trace_capture_and_summary(tmp_path):
+    """maybe_trace writes an xplane capture and summarize_trace aggregates it
+    without TensorBoard (SURVEY §5.1 — tracing with terminal analysis)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fairness_llm_tpu.utils.profiling import maybe_trace, summarize_trace
+
+    with maybe_trace(str(tmp_path), "test-region"):
+        x = jnp.ones((256, 256))
+        jax.block_until_ready(jax.jit(lambda a: (a @ a).sum())(x))
+
+    try:
+        summaries = summarize_trace(str(tmp_path), top_k=5, device_filter="")
+    except ImportError as e:
+        pytest.skip(f"xplane protos unavailable: {e}")
+    assert summaries, "no planes parsed from the capture"
+    total_events = sum(s.num_events for s in summaries)
+    assert total_events > 0
+    text = summaries[0].format()
+    assert "ms" in text and summaries[0].device in text
