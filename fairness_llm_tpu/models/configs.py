@@ -51,6 +51,16 @@ class ModelConfig:
     # Measured on v5e gpt2-small it is ~8% slower than bf16 — the dequant adds
     # work — so it's a capacity lever, not a speed lever. Opt-in.
     kv_cache_quant: bool = False
+    # Pallas fused decode-step attention (ops/decode_attention.py): keeps the
+    # per-layer scores/softmax/PV in VMEM instead of XLA's separate fusions.
+    # MEASURED SLOWER on the 45-profile sweep (104 vs 112 profiles/s on v5e;
+    # the head-major layout transposes cost more than the fusion boundaries
+    # save — docs/PERFORMANCE.md round 3), so it is OFF by default; kept as
+    # correct, oracle-tested groundwork (a native head-major cache layout is
+    # the follow-up that could flip the sign). Applies only on TPU to
+    # single-token cached steps with compatible shapes (no sliding window,
+    # no int8 cache); all other paths use XLA regardless.
+    use_decode_attention_kernel: bool = False
     # "xla" (default): dense/flash attention, GSPMD decides any resharding.
     # "ring": exact ring attention over the sp axis — the forward must run
     # inside shard_map with axis "sp" bound and activations sequence-sharded

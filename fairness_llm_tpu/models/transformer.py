@@ -174,6 +174,28 @@ class Attention(nn.Module):
 
         return flash_supported(seq_len, self.config.head_dim)
 
+    def _decode_kernel_ok(self, seq_len: int, cache_layer, batch: int, cache_len: int) -> bool:
+        """Static gate for the fused decode-attention kernel: TPU, a cached
+        SINGLE-token step (key_valid alone encodes causality there), XLA-path
+        semantics (no ring), no sliding window (mask not implemented in the
+        kernel), no int8 cache (dequantized arrays would defeat the memory
+        story), and tile-compatible shapes."""
+        cfg = self.config
+        if not (cfg.use_decode_attention_kernel and seq_len == 1 and cache_layer is not None):
+            return False
+        if cfg.sliding_window is not None or cfg.kv_cache_quant:
+            return False
+        if cfg.attention_impl != "xla" or jax.default_backend() != "tpu":
+            return False
+        if jax.device_count() > 1:
+            # Multi-chip: a bare pallas_call inside a GSPMD-partitioned
+            # program would need a shard_map wrapper; not validated on real
+            # multi-chip hardware, so the sharded path keeps XLA attention.
+            return False
+        from fairness_llm_tpu.ops.decode_attention import decode_attn_supported
+
+        return decode_attn_supported(batch, cache_len, cfg.head_dim)
+
     @nn.compact
     def __call__(
         self,
@@ -268,6 +290,18 @@ class Attention(nn.Module):
                 causal=True,
                 window=cfg.sliding_window,
             ).transpose(0, 2, 1, 3)
+        elif self._decode_kernel_ok(S, cache_layer, keys.shape[0], keys.shape[1]):
+            # Single-token cached decode: the Pallas fused kernel. key_valid
+            # alone is the mask (slots past the write index are invalid, so
+            # causality is already encoded for S == 1).
+            from fairness_llm_tpu.ops.decode_attention import decode_attention
+
+            out = decode_attention(
+                q[:, 0], keys.astype(dtype), values.astype(dtype), key_valid,
+                shared_kv=None if shared_kv is None else (
+                    shared_kv[0].astype(dtype), shared_kv[1].astype(dtype)
+                ),
+            )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
         else:
             if cache_layer is not None:
                 K = keys.shape[1]

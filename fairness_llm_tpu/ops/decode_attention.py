@@ -1,0 +1,172 @@
+"""Fused decode-step attention (Pallas TPU): one kernel call per layer per step.
+
+Why a kernel when decode attention is tiny: the round-3 device trace
+(docs/PERFORMANCE.md) showed XLA lowering each layer's single-token attention
+into several HBM-round-tripping fusions — scores written to HBM, read back
+for softmax, probabilities written again, read for the PV reduce — costing
+~140 us/layer where the data (a few MB of KV in VMEM) supports ~20 us. This
+kernel computes one head per grid step entirely in VMEM: QK^T, joint
+(shared-prefix + own-cache) online softmax, PV — nothing intermediate
+touches HBM.
+
+Layout contract (head-major, so each grid step's block is a legal TPU tile —
+dynamic head indexing on the sublane dim is forbidden, so the wrapper
+transposes to head-leading layouts; the transposes are step-local copies
+XLA fuses into the cache-update neighborhood):
+- q: [B, H, D] -> kernel sees [H, B, D], one [1, B, D] block per head
+- k/v: [B, L, Hkv, D] -> [Hkv, B, L, D], GQA head h reads block h // rep
+- valid: [B, L] bool — which cache slots hold real keys; for single-token
+  decode this already encodes causality (slots after the write index are
+  False), so it is the ONLY own-cache mask
+- shared_k/v: [P, Hkv, D] -> [Hkv, P128, D] — optional prompt prefix common
+  to every row, always causally visible; padded to a 128 multiple
+  (loop-invariant: XLA hoists the pad+transpose out of the decode
+  while_loop), masked by the true P inside the kernel
+
+Supported when D % 64 == 0, L % 128 == 0, B % 8 == 0 (else callers fall back
+to the XLA path). Sliding windows and the int8 cache use the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+_BLOCK_L = 128  # own-cache block size (flash-style L iteration)
+
+
+def decode_attn_supported(batch: int, cache_len: int, head_dim: int) -> bool:
+    if not (batch % 8 == 0 and cache_len % _BLOCK_L == 0 and head_dim % 64 == 0):
+        return False
+    # VMEM bound: each grid step holds whole [1, B, L, D] k and v blocks
+    # (double-buffered) plus f32 scratch inside the 16 MB scoped budget; a
+    # tile-compatible but oversized cache must fall back to XLA, not crash
+    # Mosaic. 4 bytes/elt is the conservative (f32-input) width.
+    kv_block_bytes = 2 * batch * cache_len * head_dim * 4
+    return kv_block_bytes <= 8 * 1024 * 1024
+
+
+def _kernel(
+    q_ref,  # [1, B, D]
+    k_ref,  # [1, B, L, D]
+    v_ref,  # [1, B, L, D]
+    valid_ref,  # [B, L] int32
+    *rest,  # ([1, P128, D] sk, sv when shared) + o_ref [1, B, D]
+    scale: float,
+    shared_len: int,
+):
+    if shared_len:
+        sk_ref, sv_ref, o_ref = rest
+    else:
+        o_ref = rest[0]
+
+    B = q_ref.shape[1]
+    D = q_ref.shape[2]
+    L = k_ref.shape[2]
+    q = q_ref[0, :, :].astype(jnp.float32) * scale  # [B, D]
+
+    # Online-softmax accumulators, seeded from the shared-prefix part (one
+    # [B, D] x [D, P128] MXU matmul — the prefix is read once per (head,
+    # step), not once per row).
+    if shared_len:
+        sk = sk_ref[0, :, :].astype(jnp.float32)  # [P128, D]
+        sv = sv_ref[0, :, :].astype(jnp.float32)
+        s_sh = jnp.dot(q, sk.T, preferred_element_type=jnp.float32)
+        sh_mask = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, sk.shape[0]), 1) < shared_len
+        )
+        s_sh = jnp.where(sh_mask, s_sh, NEG_INF)
+        m0 = jnp.max(s_sh, axis=1)  # [B]
+        p_sh = jnp.where(sh_mask, jnp.exp(s_sh - m0[:, None]), 0.0)
+        l0 = jnp.sum(p_sh, axis=1)
+        acc0 = jnp.dot(p_sh, sv, preferred_element_type=jnp.float32)  # [B, D]
+    else:
+        m0 = jnp.full((B,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B,), jnp.float32)
+        acc0 = jnp.zeros((B, D), jnp.float32)
+
+    # Own-cache attention in L-blocks of 128 (flash pattern): per-block f32
+    # casts keep peak VMEM under the 16 MB scoped budget — a whole-cache f32
+    # cast overflowed it at the sweep shape.
+    def body(lb, carry):
+        m_acc, l_acc, acc = carry
+        kb = k_ref[0, :, pl.ds(lb * _BLOCK_L, _BLOCK_L), :].astype(jnp.float32)
+        vb = v_ref[0, :, pl.ds(lb * _BLOCK_L, _BLOCK_L), :].astype(jnp.float32)
+        mask = valid_ref[:, pl.ds(lb * _BLOCK_L, _BLOCK_L)] != 0  # [B, bl]
+        # batched matvec as a VPU multiply-reduce, all in VMEM
+        s = jnp.sum(q[:, None, :] * kb, axis=-1)  # [B, bl]
+        s = jnp.where(mask, s, NEG_INF)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jnp.sum(p[:, :, None] * vb, axis=1)
+        return m_new, l_new, acc
+
+    m, l, acc = jax.lax.fori_loop(0, L // _BLOCK_L, body, (m0, l0, acc0))
+    o_ref[0, :, :] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, D]
+    k: jnp.ndarray,  # [B, L, Hkv, D]
+    v: jnp.ndarray,
+    valid: jnp.ndarray,  # [B, L] bool
+    shared_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # ([P, Hkv, D]) x2
+    interpret: bool = False,
+) -> jnp.ndarray:  # [B, H, D]
+    B, H, D = q.shape
+    L = k.shape[1]
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    if not decode_attn_supported(B, L, D):
+        raise ValueError(f"unsupported decode-attention shape B={B} L={L} D={D}")
+    scale = D ** -0.5
+
+    qh = q.transpose(1, 0, 2)  # [H, B, D]
+    kh = k.transpose(2, 0, 1, 3)  # [Hkv, B, L, D]
+    vh = v.transpose(2, 0, 1, 3)
+    args = [qh, kh, vh, valid.astype(jnp.int32)]
+    in_specs = [
+        pl.BlockSpec((1, B, D), lambda h: (h, 0, 0)),
+        pl.BlockSpec((1, B, L, D), lambda h: (h // rep, 0, 0, 0)),
+        pl.BlockSpec((1, B, L, D), lambda h: (h // rep, 0, 0, 0)),
+        pl.BlockSpec((B, L), lambda h: (0, 0)),
+    ]
+
+    if shared_kv is not None and shared_kv[0].shape[0] == 0:
+        # A zero-length prefix is the no-prefix case; passing empty refs
+        # through would desync _kernel's ref unpacking.
+        shared_kv = None
+    shared_len = 0
+    if shared_kv is not None:
+        sk, sv = shared_kv
+        shared_len = sk.shape[0]
+        pad = (-shared_len) % 128
+        if pad:
+            sk = jnp.pad(sk, ((0, pad), (0, 0), (0, 0)))
+            sv = jnp.pad(sv, ((0, pad), (0, 0), (0, 0)))
+        p128 = sk.shape[0]
+        args += [sk.transpose(1, 0, 2), sv.transpose(1, 0, 2)]  # [Hkv, P128, D]
+        in_specs += [
+            pl.BlockSpec((1, p128, D), lambda h: (h // rep, 0, 0)),
+            pl.BlockSpec((1, p128, D), lambda h: (h // rep, 0, 0)),
+        ]
+
+    kernel = functools.partial(_kernel, scale=scale, shared_len=shared_len)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((H, B, D), q.dtype),
+        grid=(H,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, B, D), lambda h: (h, 0, 0)),
+        interpret=interpret,
+    )(*args)
+    return out.transpose(1, 0, 2)  # [B, H, D]

@@ -100,7 +100,10 @@ def summarize_trace(
     if not pbs:
         raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
     if latest_only:
-        pbs = pbs[-1:]
+        # newest CAPTURE (timestamped directory), keeping every host's file
+        # in it — a flat [-1:] would drop all but one host of a pod trace
+        newest = os.path.dirname(pbs[-1])
+        pbs = [p for p in pbs if os.path.dirname(p) == newest]
     xplane_pb2 = _xplane_proto()
 
     out: List[TraceSummary] = []
