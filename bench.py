@@ -358,6 +358,48 @@ def _run() -> None:
         jax.block_until_ready(out.tokens)
         times.append(time.perf_counter() - t0)
 
+    # Fused decode-attention kernel A/B on the same sweep (measured slower —
+    # kept in the record so the regression/improvement trend is visible per
+    # round; see docs/PERFORMANCE.md round 3 and ops/decode_attention.py).
+    kernel_rate = None
+    try:
+        from fairness_llm_tpu.ops.decode_attention import decode_attn_supported
+
+        # Only measure when the kernel would actually ENGAGE at this sweep's
+        # shapes (same gate as the model) — otherwise the flag-on engine runs
+        # the identical XLA path and the record would mislabel a baseline
+        # rate as the kernel's.
+        eligible = (
+            not config.use_decode_attention_kernel
+            and jax.default_backend() == "tpu"
+            and jax.device_count() == 1
+            and config.sliding_window is None
+            and not config.kv_cache_quant
+            and decode_attn_supported(
+                out.stats["batch"], out.stats["cache_slots"],
+                config.head_dim, out.stats["prefix_len"],
+            )
+        )
+        if eligible:
+            import dataclasses
+
+            ek = DecodeEngine(
+                dataclasses.replace(config, use_decode_attention_kernel=True),
+                seed=0,
+            )
+            try:
+                ek.generate(prompts, settings, seed=0)
+                t0 = time.perf_counter()
+                outk = ek.generate(prompts, settings, seed=1)
+                jax.block_until_ready(outk.tokens)
+                kernel_rate = len(prompts) / (time.perf_counter() - t0)
+            finally:
+                # release the duplicate weights even if generate() throws —
+                # the large-sweep measurement below is already OOM-prone
+                del ek
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"decode-kernel A/B skipped: {type(e).__name__}", file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -464,6 +506,9 @@ def _run() -> None:
             "pct_v5e_hbm_roofline": round(100 * achieved_gbps / V5E_HBM_GBPS, 1),
             "vs_reference_api_sweep": round(
                 profiles_per_sec / REFERENCE_PROFILES_PER_SEC, 1
+            ),
+            "decode_attention_kernel_profiles_per_sec": (
+                round(kernel_rate, 3) if kernel_rate else None
             ),
             "large_sweep_profiles_per_sec": round(big_rate, 3) if big_rate else None,
             "large_sweep_int8kv_profiles_per_sec": (

@@ -174,7 +174,10 @@ class Attention(nn.Module):
 
         return flash_supported(seq_len, self.config.head_dim)
 
-    def _decode_kernel_ok(self, seq_len: int, cache_layer, batch: int, cache_len: int) -> bool:
+    def _decode_kernel_ok(
+        self, seq_len: int, cache_layer, batch: int, cache_len: int,
+        shared_len: int = 0,
+    ) -> bool:
         """Static gate for the fused decode-attention kernel: TPU, a cached
         SINGLE-token step (key_valid alone encodes causality there), XLA-path
         semantics (no ring), no sliding window (mask not implemented in the
@@ -194,7 +197,7 @@ class Attention(nn.Module):
             return False
         from fairness_llm_tpu.ops.decode_attention import decode_attn_supported
 
-        return decode_attn_supported(batch, cache_len, cfg.head_dim)
+        return decode_attn_supported(batch, cache_len, cfg.head_dim, shared_len)
 
     @nn.compact
     def __call__(
@@ -290,7 +293,10 @@ class Attention(nn.Module):
                 causal=True,
                 window=cfg.sliding_window,
             ).transpose(0, 2, 1, 3)
-        elif self._decode_kernel_ok(S, cache_layer, keys.shape[0], keys.shape[1]):
+        elif self._decode_kernel_ok(
+            S, cache_layer, keys.shape[0], keys.shape[1],
+            0 if shared_kv is None else shared_kv[0].shape[0],
+        ):
             # Single-token cached decode: the Pallas fused kernel. key_valid
             # alone is the mask (slots past the write index are invalid, so
             # causality is already encoded for S == 1).

@@ -40,15 +40,21 @@ NEG_INF = -1e30
 _BLOCK_L = 128  # own-cache block size (flash-style L iteration)
 
 
-def decode_attn_supported(batch: int, cache_len: int, head_dim: int) -> bool:
+def decode_attn_supported(
+    batch: int, cache_len: int, head_dim: int, shared_len: int = 0,
+) -> bool:
     if not (batch % 8 == 0 and cache_len % _BLOCK_L == 0 and head_dim % 64 == 0):
         return False
     # VMEM bound: each grid step holds whole [1, B, L, D] k and v blocks
-    # (double-buffered) plus f32 scratch inside the 16 MB scoped budget; a
-    # tile-compatible but oversized cache must fall back to XLA, not crash
-    # Mosaic. 4 bytes/elt is the conservative (f32-input) width.
+    # (double-buffered), the f32 shared-prefix operands (the shared matmul is
+    # UNBLOCKED — sk/sv cast whole plus [B, P128] scores), and f32 scratch,
+    # inside the 16 MB scoped budget; a tile-compatible but oversized shape
+    # must fall back to XLA, not crash Mosaic. 4 bytes/elt is the
+    # conservative (f32-input) width.
+    p128 = -(-shared_len // 128) * 128
     kv_block_bytes = 2 * batch * cache_len * head_dim * 4
-    return kv_block_bytes <= 8 * 1024 * 1024
+    shared_bytes = 2 * p128 * head_dim * 4 * 2 + batch * p128 * 4 * 3
+    return kv_block_bytes + shared_bytes <= 8 * 1024 * 1024
 
 
 def _kernel(

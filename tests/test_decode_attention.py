@@ -66,6 +66,9 @@ def test_supported_gate():
     assert not decode_attn_supported(48, 224, 64)  # cache not 128-multiple
     assert not decode_attn_supported(48, 256, 48)  # head_dim not 64-multiple
     assert not decode_attn_supported(48, 2048, 64)  # kv blocks over VMEM budget
+    assert decode_attn_supported(48, 256, 64, shared_len=704)  # the sweep shape
+    # a multi-thousand-token shared prefix joins the VMEM accounting
+    assert not decode_attn_supported(48, 256, 64, shared_len=30000)
 
 
 def test_zero_length_prefix_is_no_prefix():
