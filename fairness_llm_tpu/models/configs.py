@@ -61,6 +61,14 @@ class ModelConfig:
     # single-token cached steps with compatible shapes (no sliding window,
     # no int8 cache); all other paths use XLA regardless.
     use_decode_attention_kernel: bool = False
+    # Weight-only quantization for serving: "int8" stores every 2D matmul
+    # kernel (q/k/v/o, gate/up/down, untied lm_head) as int8 with per-output-
+    # channel float32 scales, dequantized INSIDE the Pallas matmul tile loop
+    # (ops/quant_matmul.py) so no bf16 copy of the tree ever exists in HBM —
+    # the capability that fits Llama-3-70B tp=8 on one v5e-8 slice (bf16 is
+    # 17.6 GB/chip vs 16 GB HBM; int8 is ~9.1 GB). Embeddings, norms, and
+    # biases stay in the float dtype. Serving-only: the train step rejects it.
+    weight_quant: str = "none"  # "none" | "int8"
     # "xla" (default): dense/flash attention, GSPMD decides any resharding.
     # "ring": exact ring attention over the sp axis — the forward must run
     # inside shard_map with axis "sp" bound and activations sequence-sharded
@@ -152,6 +160,15 @@ MODEL_CONFIGS = {
         name="llama3-70b", vocab_size=128256, num_layers=80, num_heads=64,
         num_kv_heads=8, d_model=8192, d_ff=28672, head_dim=128, max_seq_len=8192,
         rope_theta=500000.0, eos_token_id=128001, pad_token_id=128001,
+    ),
+    # The 70B serving config that actually FITS a v5e-8: int8 weights with
+    # dequant-in-tile (see weight_quant). bf16 70B at tp=8 is ~17.6 GB/chip,
+    # over a v5e's 16 GB — proven in tests/test_70b_readiness.py.
+    "llama3-70b-int8": ModelConfig(
+        name="llama3-70b-int8", vocab_size=128256, num_layers=80, num_heads=64,
+        num_kv_heads=8, d_model=8192, d_ff=28672, head_dim=128, max_seq_len=8192,
+        rope_theta=500000.0, eos_token_id=128001, pad_token_id=128001,
+        weight_quant="int8",
     ),
     "mistral-7b": ModelConfig(
         name="mistral-7b", vocab_size=32000, num_layers=32, num_heads=32,

@@ -154,6 +154,111 @@ def _activation(name: str):
     raise ValueError(f"unknown activation {name}")
 
 
+# ---------------------------------------------------------------------------
+# int8 weight-only quantized dense (serving; see ops/quant_matmul.py)
+# ---------------------------------------------------------------------------
+
+
+def _int8_kernel_init(key, shape):
+    """Random int8 kernel whose dequantized values (under the nominal scale)
+    follow ~N(0, 0.02) like the float init — exact distribution parity is
+    irrelevant for random-weight use; real checkpoints overwrite both."""
+    return jnp.clip(
+        jnp.round(jax.random.normal(key, shape) * 42.0), -127, 127
+    ).astype(jnp.int8)
+
+
+_NOMINAL_SCALE = 0.02 / 42.0
+
+
+def _scale_init(key, shape):
+    del key
+    return jnp.full(shape, _NOMINAL_SCALE, jnp.float32)
+
+
+class QuantDense(nn.Module):
+    """Dense layer storing its kernel as int8 + per-output-channel f32 scales.
+
+    The matmul dequantizes inside the Pallas tile loop (``ops/quant_matmul``),
+    so HBM never holds a float copy of the weight — the property that lets
+    llama3-70b tp=8 fit a v5e-8 (the naive dequant-at-use expression gets
+    hoisted out of the decode loop by XLA and materializes the full bf16
+    tree; docs/PERFORMANCE.md round 3). When the enclosing ``with mesh:``
+    context shards the kernel's logical axes, the matmul runs as a
+    partial-manual shard_map over those axes (column-parallel local,
+    row-parallel + psum), leaving dp/sp to GSPMD auto mode.
+    """
+
+    features: int
+    in_axis: str
+    out_axis: str
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    out_dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        from fairness_llm_tpu.ops.quant_matmul import (
+            quant_matmul,
+            quant_matmul_sharded,
+        )
+        from fairness_llm_tpu.parallel.sharding import current_mesh
+
+        in_dim = x.shape[-1]
+        wq = self.param(
+            "kernel_q",
+            nn.with_logical_partitioning(
+                _int8_kernel_init, (self.in_axis, self.out_axis)
+            ),
+            (in_dim, self.features),
+        )
+        scale = self.param(
+            "kernel_scale",
+            nn.with_logical_partitioning(_scale_init, (self.out_axis,)),
+            (self.features,),
+        )
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, in_dim).astype(self.dtype)
+        out_dtype = self.out_dtype or self.dtype
+
+        mesh = current_mesh()
+        if mesh is None or all(s == 1 for s in mesh.shape.values()):
+            y = quant_matmul(x2, wq, scale, out_dtype=out_dtype)
+        else:
+            spec = nn.logical_to_mesh_axes(
+                (self.in_axis, self.out_axis, "batch", "seq")
+            )
+            k_axis, n_axis, b_axis, s_axis = (
+                a if a and mesh.shape.get(a, 1) > 1 else None for a in tuple(spec)
+            )
+            if b_axis is not None and x2.shape[0] % mesh.shape[b_axis] != 0:
+                # batch=1 shared-prefix forward (rows = sequence positions),
+                # or any batch not divisible by dp: replicate rows instead.
+                # (Matmul rows are independent, so ANY row layout is correct;
+                # divisibility is shard_map's hard requirement.)
+                b_axis = None
+            if s_axis is not None and x.ndim >= 3 and x.shape[1] > 1:
+                # Sequence-sharded activations: x2's rows interleave B and S
+                # shards, which P(b_axis, ...) cannot express. The XLA dequant
+                # matmul is fine here — sp meshes are the training/scoring
+                # forward, which runs OUTSIDE any decode loop (nothing for
+                # XLA to hoist a float tree across).
+                y = jnp.dot(
+                    x2, wq.astype(x2.dtype), preferred_element_type=jnp.float32
+                )
+                y = (y * scale[None, :].astype(jnp.float32)).astype(out_dtype)
+            else:
+                y = quant_matmul_sharded(
+                    x2, wq, scale, mesh, k_axis, n_axis, b_axis,
+                    out_dtype=out_dtype,
+                )
+        y = y.reshape(*lead, self.features)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            y = y + bias.astype(y.dtype)
+        return y
+
+
 class Attention(nn.Module):
     config: ModelConfig
 
@@ -165,14 +270,83 @@ class Attention(nn.Module):
         from a per-row length, which is only correct when valid tokens occupy
         the trailing slots). The decode engine always left-pads; other callers
         must opt in via ``left_padded=True``.
+
+        Under a sharded mesh the kernel runs per-shard via shard_map (see
+        ``_flash_dispatch``); that wrap is only correct when q and kv heads
+        shard the SAME way — the tp=16 GQA fallback (q sharded, kv
+        replicated) would change the per-shard head ratio, so it stays on
+        the XLA dense path.
         """
         if not (self.config.use_flash_attention and left_padded) or seq_len <= 1:
             return False
-        if jax.default_backend() != "tpu":
+        from fairness_llm_tpu.ops.quant_matmul import _FORCE_PALLAS
+
+        if jax.default_backend() != "tpu" and not _FORCE_PALLAS:
+            return False
+        _, qh_ax, kv_ax = self._mesh_axes()
+        if qh_ax != kv_ax:
             return False
         from fairness_llm_tpu.ops import flash_supported
 
         return flash_supported(seq_len, self.config.head_dim)
+
+    def _mesh_axes(self):
+        """(batch, q_heads, kv_heads) mesh axes actually sharded (>1) under
+        the enclosing mesh + logical-rules context, else Nones."""
+        from fairness_llm_tpu.parallel.sharding import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            return None, None, None
+        spec = nn.logical_to_mesh_axes(("batch", "q_heads", "kv_heads"))
+        return tuple(
+            a if a and mesh.shape.get(a, 1) > 1 else None for a in tuple(spec)
+        )
+
+    def _flash_dispatch(self, q, k, v, lengths):
+        """Run flash attention; under a sharded mesh, per-shard.
+
+        A bare Mosaic ``pallas_call`` cannot be partitioned by GSPMD — a
+        multi-chip program must wrap it in ``shard_map``. Heads are
+        per-kernel-instance, and each batch row is masked independently, so
+        sharding batch over dp and heads over tp is exactly local; other
+        mesh axes stay GSPMD-auto. (Single-chip callers skip the wrap.)
+        """
+        from fairness_llm_tpu.ops import flash_attention
+        from fairness_llm_tpu.parallel.sharding import current_mesh
+
+        window = self.config.sliding_window
+
+        def call(q, k, v, lengths):
+            return flash_attention(q, k, v, lengths, causal=True, window=window)
+
+        mesh = current_mesh()
+        if mesh is None or all(s == 1 for s in mesh.shape.values()):
+            return call(q, k, v, lengths)
+        # Full-manual: Mosaic kernels refuse partially-auto SPMD contexts
+        # (see ops/quant_matmul.quant_matmul_sharded); unnamed spec entries
+        # are replicated per shard.
+        b_ax, qh_ax, kv_ax = self._mesh_axes()
+        if b_ax is not None and q.shape[0] % mesh.shape[b_ax] != 0:
+            # The engine's shared-prefix prefill runs batch=1 ([1, Pc]
+            # tokens) on any mesh; an indivisible batch dim replicates
+            # instead of sharding (shard_map requires exact divisibility).
+            b_ax = None
+        from jax.sharding import PartitionSpec as P
+
+        return jax.shard_map(
+            call,
+            mesh=mesh,
+            axis_names=frozenset(mesh.axis_names),
+            in_specs=(
+                P(b_ax, qh_ax, None, None),
+                P(b_ax, kv_ax, None, None),
+                P(b_ax, kv_ax, None, None),
+                P(b_ax),
+            ),
+            out_specs=P(b_ax, qh_ax, None, None),
+            check_vma=False,
+        )(q, k, v, lengths)
 
     def _decode_kernel_ok(
         self, seq_len: int, cache_layer, batch: int, cache_len: int,
@@ -219,15 +393,21 @@ class Attention(nn.Module):
         cfg = self.config
         dtype = _dtype_of(cfg)
         # qwen2 carries biases on q/k/v only (o_proj and MLP stay bias-free).
-        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
-            feats,
-            use_bias=cfg.use_bias or cfg.qkv_bias,
-            dtype=dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("embed", axes)
-            ),
-            name=name,
-        )
+        if cfg.weight_quant == "int8":
+            dense = lambda feats, axes, name: QuantDense(  # noqa: E731
+                feats, in_axis="embed", out_axis=axes,
+                use_bias=cfg.use_bias or cfg.qkv_bias, dtype=dtype, name=name,
+            )
+        else:
+            dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
+                feats,
+                use_bias=cfg.use_bias or cfg.qkv_bias,
+                dtype=dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", axes)
+                ),
+                name=name,
+            )
         B, S, _ = x.shape
         q = dense(cfg.q_dim, "q_heads", "q_proj")(x).reshape(B, S, cfg.num_heads, cfg.head_dim)
         k = dense(cfg.kv_dim, "kv_heads", "k_proj")(x).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
@@ -277,21 +457,17 @@ class Attention(nn.Module):
             # S > 1 is the engine's static marker; a chunked-prefill caller
             # must set use_flash_attention=False). In both cases the NEW k/v
             # are the entire key set, so the kernel sees only [B, S].
-            from fairness_llm_tpu.ops import flash_attention
-
             # With an int8 cache, later decode steps attend to the quantization
             # round-trip of these keys/values — attend to the same dequantized
             # tensors here so flash-eligible and fallback shapes agree.
             fk, fv = (keys[:, :S], values[:, :S]) if (
                 cfg.kv_cache_quant and cache_layer is not None
             ) else (k, v)
-            out = flash_attention(
+            out = self._flash_dispatch(
                 q.transpose(0, 2, 1, 3),
                 fk.astype(dtype).transpose(0, 2, 1, 3),
                 fv.astype(dtype).transpose(0, 2, 1, 3),
                 jnp.sum(key_valid[:, :S], axis=1, dtype=jnp.int32),
-                causal=True,
-                window=cfg.sliding_window,
             ).transpose(0, 2, 1, 3)
         elif self._decode_kernel_ok(
             S, cache_layer, keys.shape[0], keys.shape[1],
@@ -355,15 +531,21 @@ class Attention(nn.Module):
                 out = jnp.einsum("bhqk,bkhd->bqhd", probs, dense_values)
 
         out = out.reshape(B, S, cfg.q_dim)
-        out = nn.DenseGeneral(
-            cfg.d_model,
-            use_bias=cfg.use_bias,
-            dtype=dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("q_heads", "embed")
-            ),
-            name="o_proj",
-        )(out)
+        if cfg.weight_quant == "int8":
+            out = QuantDense(
+                cfg.d_model, in_axis="q_heads", out_axis="embed",
+                use_bias=cfg.use_bias, dtype=dtype, name="o_proj",
+            )(out)
+        else:
+            out = nn.DenseGeneral(
+                cfg.d_model,
+                use_bias=cfg.use_bias,
+                dtype=dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("q_heads", "embed")
+                ),
+                name="o_proj",
+            )(out)
         return out, new_cache_layer
 
 
@@ -376,17 +558,30 @@ class MLP(nn.Module):
         dtype = _dtype_of(cfg)
         act = _activation(cfg.activation)
         use_bias = cfg.use_bias
-        up_init = nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", "ff"))
-        down_init = nn.with_logical_partitioning(nn.initializers.normal(0.02), ("ff", "embed"))
-        if cfg.mlp == "glu":
-            gate = nn.DenseGeneral(cfg.d_ff, use_bias=use_bias, dtype=dtype, kernel_init=up_init, name="gate_proj")(x)
-            up = nn.DenseGeneral(cfg.d_ff, use_bias=use_bias, dtype=dtype, kernel_init=up_init, name="up_proj")(x)
-            h = act(gate) * up
+        if cfg.weight_quant == "int8":
+            up_d = lambda name: QuantDense(  # noqa: E731
+                cfg.d_ff, in_axis="embed", out_axis="ff",
+                use_bias=use_bias, dtype=dtype, name=name,
+            )
+            down_d = QuantDense(
+                cfg.d_model, in_axis="ff", out_axis="embed",
+                use_bias=use_bias, dtype=dtype, name="down_proj",
+            )
         else:
-            h = act(nn.DenseGeneral(cfg.d_ff, use_bias=use_bias, dtype=dtype, kernel_init=up_init, name="up_proj")(x))
-        return nn.DenseGeneral(
-            cfg.d_model, use_bias=use_bias, dtype=dtype, kernel_init=down_init, name="down_proj"
-        )(h)
+            up_init = nn.with_logical_partitioning(nn.initializers.normal(0.02), ("embed", "ff"))
+            down_init = nn.with_logical_partitioning(nn.initializers.normal(0.02), ("ff", "embed"))
+            up_d = lambda name: nn.DenseGeneral(  # noqa: E731
+                cfg.d_ff, use_bias=use_bias, dtype=dtype, kernel_init=up_init, name=name,
+            )
+            down_d = nn.DenseGeneral(
+                cfg.d_model, use_bias=use_bias, dtype=dtype, kernel_init=down_init,
+                name="down_proj",
+            )
+        if cfg.mlp == "glu":
+            h = act(up_d("gate_proj")(x)) * up_d("up_proj")(x)
+        else:
+            h = act(up_d("up_proj")(x))
+        return down_d(h)
 
 
 class Block(nn.Module):
@@ -498,6 +693,12 @@ class Transformer(nn.Module):
                 "bsd,vd->bsv", x, embed.astype(x.dtype),
                 preferred_element_type=jnp.float32,
             )
+        elif cfg.weight_quant == "int8":
+            logits = QuantDense(
+                cfg.vocab_size, in_axis="embed", out_axis="vocab",
+                use_bias=False, dtype=_dtype_of(cfg), out_dtype=jnp.float32,
+                name="lm_head",
+            )(x)
         else:
             lm_head = self.param(
                 "lm_head",
@@ -544,7 +745,11 @@ def init_params_lowmem(config: ModelConfig, rng: jax.Array, dtype=None) -> Any:
     for i, (path, leaf) in enumerate(flat):
         name = "/".join(str(p.key) for p in path if hasattr(p, "key"))
         key = jax.random.fold_in(rng, i)
-        if name.endswith("scale"):
+        if name.endswith("kernel_q"):  # before the generic "scale"/bias rules
+            leaves.append(_int8_kernel_init(key, leaf.shape))
+        elif name.endswith("kernel_scale"):
+            leaves.append(_scale_init(key, leaf.shape))
+        elif name.endswith("scale"):
             leaves.append(jnp.ones(leaf.shape, dtype))
         elif name.endswith("bias"):
             leaves.append(jnp.zeros(leaf.shape, dtype))

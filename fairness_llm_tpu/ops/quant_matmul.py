@@ -1,0 +1,234 @@
+"""int8 weight-only matmul with dequantization INSIDE the Pallas tile loop.
+
+Why a kernel instead of ``x @ (wq * scale)``: XLA hoists loop-invariant
+computation out of decode loops. For int8-stored weights that "obvious"
+dequant-at-use expression materializes a full bf16 copy of the parameter
+tree in HBM (measured round 3: llama3-70b tp=8 — int8 args 8.84 GB/chip
+would fit a v5e, but 35.2 GB of hoisted bf16 temps; docs/PERFORMANCE.md).
+Inside a Pallas kernel the int8->bf16 conversion happens per [bk, bn] tile
+in VMEM, so HBM only ever holds the int8 tree: weight-only-quantized
+serving streams half the bytes AND fits models that bf16 cannot.
+
+Scheme: symmetric per-output-channel quantization. ``w ≈ wq * scale[None, :]``
+with ``wq`` int8 and ``scale`` float32. Because the scale is constant along
+the contraction axis it commutes with the matmul:
+
+    x @ (wq * scale[None, :]) == (x @ wq) * scale[None, :]
+
+so the kernel runs the MXU matmul on (bf16 x, int8->bf16 wq) tiles with a
+float32 accumulator and applies the scale once, on the final K step. The
+int8->bf16 cast is exact (|q| <= 127 << 2^8), making the kernel numerically
+equivalent to a bf16 matmul against the dequantized weights.
+
+Sharding: ``quant_matmul_sharded`` wraps the kernel in a partial-manual
+``jax.shard_map`` over the mesh axes that shard the weight — column-parallel
+(N sharded) runs purely locally; row-parallel (K sharded) adds the
+``psum`` that GSPMD would have inserted for the dense equivalent. Other
+mesh axes (dp batch sharding) stay in GSPMD "auto" mode. This is the
+trace-time-lowered integration (works under AOT topology compilation, where
+``custom_partitioning``'s runtime callback is unavailable).
+
+The reference has no quantization support at all (its models are remote
+APIs, SURVEY.md §0); this is the capability that puts Llama-3-70B tp=8 — a
+``BASELINE.json`` target config — on a single v5e-8 slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "quantize_weight",
+    "dequantize_weight",
+    "quant_tileable",
+    "quant_matmul",
+    "quant_matmul_sharded",
+    "force_pallas",
+]
+
+_LANE = 128  # TPU lane width: last-dim tiling granule for every dtype
+
+# Dispatch override for AOT lowering: ``jax.default_backend()`` reports the
+# process's live backend, not the topology being lowered FOR — a CPU-pinned
+# test process AOT-compiling against a TPU topology descriptor must still
+# take the Pallas path (that's the thing being proven). Context-managed, not
+# an argument, because the call sites sit inside flax modules.
+_FORCE_PALLAS: list = []
+
+
+class force_pallas:
+    """``with force_pallas():`` — treat the lowering target as TPU."""
+
+    def __enter__(self):
+        _FORCE_PALLAS.append(True)
+        return self
+
+    def __exit__(self, *exc):
+        _FORCE_PALLAS.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Quantization (host/XLA side)
+# ---------------------------------------------------------------------------
+
+
+def quantize_weight(w: jnp.ndarray, axis: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[K, N] float -> (int8 [K, N], float32 scale [N]); symmetric per-channel.
+
+    ``axis`` is the contraction (reduced) axis; scales live on the other one.
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / jnp.expand_dims(scale, axis)), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_weight(wq: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (wq.astype(jnp.float32) * scale[None, :].astype(jnp.float32)).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+def _matmul_kernel(x_ref, wq_ref, scale_ref, o_ref, acc_ref, *, nk: int):
+    """One (m, n) output tile; grid dim 2 walks K accumulating into VMEM f32."""
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # int8 -> x.dtype happens HERE, on a [bk, bn] tile already in VMEM — the
+    # whole point of the kernel: no dequantized copy of the weight ever
+    # exists in HBM, and XLA cannot hoist what it cannot see.
+    acc_ref[:] += jnp.dot(
+        x_ref[:], wq_ref[:].astype(x_ref.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(pl.program_id(2) == nk - 1)
+    def _done():
+        o_ref[:] = (acc_ref[:] * scale_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _pick_block(dim: int, candidates=(512, 256, 128)) -> int:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return 0
+
+
+def quant_tileable(k: int, n: int) -> bool:
+    """Static gate: can the Pallas kernel tile a [k, n] int8 weight?
+
+    Both dims must hit a 128-multiple block (last-dim lane constraint; K
+    blocks stay MXU-sized). Callers fall back to the XLA dequant matmul when
+    this fails (e.g. llama's 128256 vocab sharded 8 ways -> 16032, not a
+    lane multiple).
+    """
+    return k > 0 and n > 0 and k % _LANE == 0 and n % _LANE == 0
+
+
+def _quant_matmul_pallas(x, wq, scale, interpret: bool, out_dtype):
+    m, k = x.shape
+    _, n = wq.shape
+    bm = m if m % 8 == 0 else -(-m // 8) * 8
+    if bm != m:
+        x = jnp.pad(x, ((0, bm - m), (0, 0)))
+    bm_t = min(bm, 256)
+    while bm % bm_t:
+        bm_t //= 2
+    bk, bn = _pick_block(k), _pick_block(n)
+    nk = k // bk
+    out = pl.pallas_call(
+        functools.partial(_matmul_kernel, nk=nk),
+        grid=(bm // bm_t, n // bn, nk),
+        in_specs=[
+            pl.BlockSpec((bm_t, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm_t, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((bm, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm_t, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, wq, scale[None, :])
+    return out[:m]
+
+
+def quant_matmul(
+    x: jnp.ndarray,
+    wq: jnp.ndarray,
+    scale: jnp.ndarray,
+    *,
+    interpret: bool = False,
+    out_dtype=None,
+) -> jnp.ndarray:
+    """``x [M, K] @ dequant(wq [K, N], scale [N]) -> [M, N]``.
+
+    Pallas on TPU (or under ``interpret=True`` anywhere); otherwise the XLA
+    expression with the SAME operation order as the kernel (cast-then-matmul-
+    then-scale) so both paths agree to float rounding, not just mathematically.
+    """
+    out_dtype = out_dtype or x.dtype
+    on_tpu = jax.default_backend() == "tpu" or bool(_FORCE_PALLAS)
+    if (on_tpu or interpret) and quant_tileable(*wq.shape):
+        return _quant_matmul_pallas(x, wq, scale, interpret, out_dtype)
+    y = jnp.dot(x, wq.astype(x.dtype), preferred_element_type=jnp.float32)
+    return (y * scale[None, :].astype(jnp.float32)).astype(out_dtype)
+
+
+def quant_matmul_sharded(
+    x: jnp.ndarray,
+    wq: jnp.ndarray,
+    scale: jnp.ndarray,
+    mesh: jax.sharding.Mesh,
+    k_axis: Optional[str],
+    n_axis: Optional[str],
+    b_axis: Optional[str] = None,
+    *,
+    interpret: bool = False,
+    out_dtype=None,
+) -> jnp.ndarray:
+    """The kernel under ``shard_map``, manual over EVERY mesh axis.
+
+    ``k_axis``/``n_axis``: mesh axis (or None) sharding the weight's
+    contraction / output dim; ``b_axis``: the axis sharding x's rows (dp).
+    Column-parallel (n_axis) is purely local; row-parallel (k_axis) psums
+    partial products — exactly the collective GSPMD inserts for the dense
+    row-parallel matmul.
+
+    Why full-manual: Mosaic kernels refuse to lower in a partially-auto
+    SPMD context (``tpu_custom_call.py`` requires manual_axes == all mesh
+    axes), so the wrap names every axis and encodes batch sharding in the
+    specs instead of leaving it to GSPMD. Axes that shard nothing here are
+    manual-but-unused (their spec entries are None == replicated).
+    """
+    out_dtype = out_dtype or x.dtype
+
+    def local(xl, wql, scalel):
+        y = quant_matmul(xl, wql, scalel, interpret=interpret, out_dtype=out_dtype)
+        if k_axis is not None:
+            y = jax.lax.psum(y, k_axis)
+        return y
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        axis_names=frozenset(mesh.axis_names),
+        in_specs=(P(b_axis, k_axis), P(k_axis, n_axis), P(n_axis)),
+        out_specs=P(b_axis, n_axis),
+        check_vma=False,
+    )(x, wq, scale)

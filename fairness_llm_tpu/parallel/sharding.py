@@ -53,6 +53,21 @@ def make_mesh(mesh_config: MeshConfig, devices: Optional[List] = None) -> Mesh:
     return Mesh(arr, mesh_config.axis_names, axis_types=axis_types)
 
 
+def current_mesh() -> Optional[Mesh]:
+    """The physical mesh of the enclosing ``with mesh:`` block, or None.
+
+    The engine and train step run every traced call inside ``with mesh,
+    nn.logical_axis_rules(...)`` — the same thread-local flax reads for
+    ``with_logical_constraint``. Modules that must make trace-time sharding
+    decisions (QuantDense's shard_map over the weight's tp axis) read it
+    here instead of threading a mesh attribute through every layer.
+    """
+    from jax._src import mesh as jax_mesh  # no public accessor as of jax 0.9
+
+    m = jax_mesh.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
 def make_axis_rules(model_config: ModelConfig, mesh: Mesh) -> AxisRules:
     """Logical->mesh axis rules, dropping mappings that don't divide evenly.
 
@@ -140,9 +155,11 @@ def per_device_param_bytes(model_config: ModelConfig, mesh: Mesh,
     llama3-70b bf16 at tp=8 is ~17.6 GB/chip, OVER a v5e's 16 GB HBM (the fit
     paths are tp=16 across two v5e-8 slices, or int8 weights).
 
-    ``itemsize`` overrides the config-dtype byte width — the engine stores
-    small bf16-config models in float32 (see DecodeEngine param policy) and
-    passes its actual storage width.
+    ``itemsize`` overrides the config-dtype byte width for FLOAT leaves —
+    the engine stores small bf16-config models in float32 (see DecodeEngine
+    param policy) and passes its actual storage width. Integer leaves (the
+    int8 kernels of a ``weight_quant`` model) always count at their own
+    width: storage policy never widens them.
     """
     if rules is None:
         rules = make_axis_rules(model_config, mesh)
@@ -159,7 +176,11 @@ def per_device_param_bytes(model_config: ModelConfig, mesh: Mesh,
         for axis in resolved:
             if axis is not None:
                 div *= mesh.shape.get(axis, 1)
-        total += int(np.prod(leaf.shape)) * itemsize // div
+        item = (
+            itemsize if jnp.issubdtype(leaf.dtype, jnp.floating)
+            else jnp.dtype(leaf.dtype).itemsize
+        )
+        total += int(np.prod(leaf.shape)) * item // div
     return total
 
 

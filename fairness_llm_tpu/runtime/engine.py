@@ -165,10 +165,15 @@ class DecodeEngine:
                 model_config, jax.random.key(seed), dtype=param_dtype
             )
         elif param_dtype == jnp.bfloat16:
-            params = jax.tree.map(
-                lambda x: x.astype(jnp.bfloat16)
-                if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-                else x,
+            # Float leaves only: int8 kernels stay int8, and the per-channel
+            # quant scales stay f32 (the kernel reads them in f32; rounding
+            # them to bf16 would perturb every dequantized weight for no
+            # memory win — they're one scalar per output channel).
+            params = jax.tree_util.tree_map_with_path(
+                lambda path, x: x
+                if (path and getattr(path[-1], "key", None) == "kernel_scale")
+                or not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating))
+                else x.astype(jnp.bfloat16),
                 params,
             )
         if self.mesh is not None and not assume_sharded:

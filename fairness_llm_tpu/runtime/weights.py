@@ -200,6 +200,7 @@ def load_checkpoint(
     name_map = hf_name_map(cfg, family)
     weight_map = _checkpoint_index(path)
     shardings = shd.param_shardings(cfg, mesh) if mesh is not None else None
+    quant = cfg.weight_quant == "int8"
 
     handles: Dict[str, Any] = {}
 
@@ -210,15 +211,28 @@ def load_checkpoint(
             handles[fname] = safe_open(os.path.join(path, fname), framework="flax")
         return handles[fname].get_tensor(hf_name)
 
+    def place(our_path: str, x: Any) -> None:
+        leaf_sharding = _tree_get(shardings, our_path) if shardings is not None else None
+        x = jax.device_put(x, leaf_sharding) if leaf_sharding is not None else jnp.asarray(x)
+        _tree_set(params, our_path, x)
+
     params: Dict[str, Any] = {}
     try:
         for our_path, (hf_name, transform) in name_map.items():
-            x = transform(get_tensor(hf_name)).astype(dtype)
-            leaf_sharding = None
-            if shardings is not None:
-                leaf_sharding = _tree_get(shardings, our_path)
-            x = jax.device_put(x, leaf_sharding) if leaf_sharding is not None else jnp.asarray(x)
-            _tree_set(params, our_path, x)
+            x = transform(get_tensor(hf_name))
+            if quant and _quant_base(our_path) is not None:
+                # int8 serving: quantize each matmul kernel AS IT STREAMS off
+                # disk — the float tensor exists one at a time; HBM (and for
+                # 70B-class checkpoints, host RAM) never holds a float tree.
+                from fairness_llm_tpu.ops.quant_matmul import quantize_weight
+
+                base = _quant_base(our_path)
+                q, s = quantize_weight(jnp.asarray(x))
+                place(f"{base}/kernel_q", q)
+                place(f"{base}/kernel_scale", s)
+                logger.debug("loaded %s <- %s %s (int8)", base, hf_name, q.shape)
+                continue
+            place(our_path, x.astype(dtype))
             logger.debug("loaded %s <- %s %s", our_path, hf_name, x.shape)
     finally:
         # Shard handles hold open fds + mmaps; a multi-shard 70B checkpoint
@@ -228,14 +242,98 @@ def load_checkpoint(
     return params
 
 
+def _quant_base(our_path: str) -> Optional[str]:
+    """For a float-tree kernel path, the QuantDense module base path that
+    replaces it under ``weight_quant='int8'`` — else None. Quantizable =
+    every 2D matmul kernel: DenseGeneral ``.../kernel`` and the untied
+    ``lm_head``; embeddings (gathered, not streamed whole), norms, and
+    biases stay float."""
+    if our_path.endswith("/kernel"):
+        return our_path[: -len("/kernel")]
+    if our_path == "lm_head":
+        return "lm_head"
+    return None
+
+
+def quantize_params(params: Any) -> Any:
+    """Float param tree -> the ``weight_quant='int8'`` tree layout.
+
+    For tests and for quantizing in-memory weights (e.g. after fine-tuning);
+    ``load_checkpoint`` quantizes tensor-at-a-time off disk instead.
+    """
+    from fairness_llm_tpu.ops.quant_matmul import quantize_weight
+
+    out = _copy_tree(params)
+    for path in list(_walk_paths(out)):
+        base = _quant_base(path)
+        if base is None:
+            continue
+        q, s = quantize_weight(jnp.asarray(_tree_get(out, path)))
+        node = out
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node[part]
+        del node[parts[-1]]
+        _tree_set(out, f"{base}/kernel_q", q)
+        _tree_set(out, f"{base}/kernel_scale", s)
+    return out
+
+
+def dequantize_params(params: Any, dtype=jnp.float32) -> Any:
+    """Inverse of ``quantize_params`` (up to quantization rounding)."""
+    from fairness_llm_tpu.ops.quant_matmul import dequantize_weight
+
+    out = _copy_tree(params)
+    for path in list(_walk_paths(out)):
+        if not path.endswith("/kernel_q"):
+            continue
+        base = path[: -len("/kernel_q")]
+        module = _tree_get(out, base)  # the QuantDense param dict
+        w = dequantize_weight(
+            jnp.asarray(module["kernel_q"]), jnp.asarray(module["kernel_scale"]), dtype
+        )
+        # Remove only the quant leaves — siblings (qwen2/gpt2 biases) stay.
+        del module["kernel_q"], module["kernel_scale"]
+        if base == "lm_head" and not module:
+            # lm_head is a bare param leaf in the float tree, not a module
+            parts = base.split("/")
+            node = out
+            for part in parts[:-1]:
+                node = node[part]
+            node[parts[-1]] = w
+        else:
+            _tree_set(out, f"{base}/kernel", w)
+    return out
+
+
+def _copy_tree(tree: Any) -> Any:
+    """Structure-copy of a nested dict (leaves shared, dicts fresh)."""
+    return {
+        k: _copy_tree(v) if isinstance(v, dict) else v for k, v in tree.items()
+    }
+
+
+def _walk_paths(tree: Any, prefix: str = "") -> Any:
+    for key, val in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(val, dict):
+            yield from _walk_paths(val, path)
+        else:
+            yield path
+
+
 def save_checkpoint_hf(cfg: ModelConfig, params: Any, path: str, family: Optional[str] = None) -> None:
     """Inverse mapping: write our params as an HF-layout safetensors file.
 
     Used by tests (fabricate a checkpoint, round-trip it) and for exporting.
-    Fused tensors (gpt2 c_attn) are reassembled from their parts.
+    Fused tensors (gpt2 c_attn) are reassembled from their parts; int8 trees
+    export dequantized (HF layouts have no per-channel-int8 convention we
+    target).
     """
     from safetensors.flax import save_file
 
+    if cfg.weight_quant == "int8":
+        params = dequantize_params(params)
     name_map = hf_name_map(cfg, family)
     family = family or family_of(cfg)
     out: Dict[str, jnp.ndarray] = {}

@@ -65,6 +65,14 @@ def make_train_step(
     mesh is given, already wrapped in the mesh + logical-axis-rules context
     (deterministic: no dropout, hence no rng argument).
     """
+    if model_config.weight_quant != "none":
+        # int8 kernels are not differentiable leaves (jax.grad rejects int8,
+        # and adamw moments over them would be meaningless anyway). Training
+        # happens in float; quantize AFTER with runtime.weights.quantize_params.
+        raise ValueError(
+            f"weight_quant={model_config.weight_quant!r} is serving-only; "
+            "train in float and quantize the result"
+        )
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     model = Transformer(model_config)
     rules = shd.make_axis_rules(model_config, mesh) if mesh is not None else ()
@@ -159,6 +167,11 @@ def make_sequence_parallel_train_step(
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if model_config.weight_quant != "none":
+        raise ValueError(
+            f"weight_quant={model_config.weight_quant!r} is serving-only; "
+            "train in float and quantize the result"
+        )
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     ring_config = dataclasses.replace(model_config, attention_impl="ring")
     model = Transformer(ring_config)

@@ -155,3 +155,84 @@ def test_70b_decode_kv_cache_estimate():
     # 2 * 80 layers * 48 * 1024 * 1 head * 128 dim * 2 B = ~2.0 GB/chip
     got = shd.per_device_kv_cache_bytes(cfg, mesh, batch=48, max_len=1024, rules=rules)
     assert got == 2 * 80 * 48 * 1024 * 1 * 128 * 2
+
+
+def test_70b_int8_layer_compiles_on_v5e_topology():
+    """The int8 fit proof's LOWERING, at suite speed: a 2-layer model with
+    llama3-70b's exact per-layer dimensions, int8 weights, tp=8, compiled by
+    the REAL v5e TPU compiler against a topology descriptor — every Pallas
+    quant matmul, shard_map wrap, and collective the 80-layer program uses,
+    in ~1/40th the compile time. The full-model compile (memory analysis:
+    9.29 GB/chip vs 15.75 — fits) is tools/prove_70b_int8_fit.py, recorded
+    in the bench as ``int8_70b_fit``. Temps must stay activation-scale: the
+    round-3 negative was 35 GB of hoisted bf16 dequants, which two layers
+    would already betray (~0.9 GB of kernels -> bf16 temps would dwarf the
+    0.1 GB activation budget this asserts)."""
+    import dataclasses
+
+    import jax.tree_util as jtu
+
+    try:
+        from jax.experimental import topologies
+
+        td = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x4")
+    except Exception as e:  # noqa: BLE001 — no TPU compiler in this env
+        pytest.skip(f"TPU topology unavailable: {type(e).__name__}")
+    from fairness_llm_tpu.models.transformer import init_cache
+    from fairness_llm_tpu.ops.quant_matmul import force_pallas
+
+    cfg = dataclasses.replace(
+        get_model_config("llama3-70b-int8"), name="llama3-70b-int8-2l", num_layers=2
+    )
+    import numpy as np
+
+    mesh = jax.sharding.Mesh(
+        np.array(td.devices).reshape(1, 8, 1), ("dp", "tp", "sp")
+    )
+    rules = shd.make_axis_rules(cfg, mesh)
+    shardings = shd.param_shardings(cfg, mesh, rules)
+    model = Transformer(cfg)
+    abstract = nn.meta.unbox(
+        jax.eval_shape(
+            model.init, jax.random.key(0),
+            jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32),
+        )["params"]
+    )
+    flat, treedef = jtu.tree_flatten_with_path(abstract)
+    aleaves = []
+    for (path, leaf), s in zip(flat, jtu.tree_leaves(shardings)):
+        name = getattr(path[-1], "key", "")
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            dt = leaf.dtype
+        else:
+            dt = jnp.float32 if name == "kernel_scale" else jnp.bfloat16
+        aleaves.append(jax.ShapeDtypeStruct(leaf.shape, dt, sharding=s))
+    aparams = jtu.tree_unflatten(treedef, aleaves)
+
+    B, S = 8, 128
+
+    def prefill_and_step(params, tokens, positions, valid):
+        cache = init_cache(cfg, B, S + 1)
+        logits, cache = model.apply(
+            {"params": params}, tokens, positions, valid, cache,
+            left_padded=True, last_only=True,
+        )
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        logits, _ = model.apply(
+            {"params": params}, tok[:, None], cache.lengths[:, None],
+            jnp.ones((B, 1), jnp.bool_), cache,
+        )
+        return logits
+
+    bs = shd.batch_sharding(mesh)
+    atoks = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bs)
+    avalid = jax.ShapeDtypeStruct((B, S), jnp.bool_, sharding=bs)
+    with mesh, nn.logical_axis_rules(rules), force_pallas():
+        compiled = (
+            jax.jit(prefill_and_step).lower(aparams, atoks, atoks, avalid).compile()
+        )
+    ma = compiled.memory_analysis()
+    # int8 kernels dominate args; temps stay activation-scale (no hoisted
+    # bf16 copy of the weights — the property the kernel exists to provide).
+    assert ma.argument_size_in_bytes < 1.5e9
+    assert ma.temp_size_in_bytes < 0.5e9
