@@ -44,7 +44,10 @@ def parse_mesh(spec: Optional[str]) -> MeshConfig:
         k, _, v = part.partition("=")
         if k.strip() not in ("dp", "tp", "sp"):
             raise SystemExit(f"unknown mesh axis '{k}' (use dp/tp/sp)")
-        kwargs[k.strip()] = int(v)
+        try:
+            kwargs[k.strip()] = int(v)
+        except ValueError:
+            raise SystemExit(f"bad mesh spec '{part}' (use e.g. dp=2,tp=4)") from None
     return MeshConfig(**kwargs)
 
 
@@ -110,6 +113,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "max_tokens (bounds per-sweep decode cost)")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
+    p.add_argument("--weight-quant", default=None, choices=("none", "int8"),
+                   help="weight-only quantization for served models: int8 "
+                        "stores matmul kernels as int8 with dequant inside "
+                        "the Pallas tile (fits llama3-70b tp=8 on a v5e-8)")
     p.add_argument("--data-dir", default=None, help="MovieLens-1M directory")
     p.add_argument("--results-dir", default=None)
     p.add_argument("--seed", type=int, default=None)
@@ -128,6 +135,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["mesh"] = parse_mesh(args.mesh)
     if args.weights_dir:
         updates["weights_dir"] = args.weights_dir
+    if args.weight_quant is not None:
+        updates["weight_quant"] = args.weight_quant
     if args.data_dir:
         updates["data_dir"] = args.data_dir
     if args.results_dir:

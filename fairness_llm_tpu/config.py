@@ -103,6 +103,12 @@ class Config:
     # Default 512 >= every per-model setting, so defaults change nothing.
     max_new_tokens: int = 512
     weights_dir: Optional[str] = None  # directory of HF safetensors checkpoints
+    # Weight-only quantization for served models (None = use each model
+    # config's own weight_quant; "none"/"int8" = explicit override both
+    # ways, so --weight-quant none can force float serving even for
+    # llama3-70b-int8). The int8 mode is the capacity lever that fits
+    # llama3-70b tp=8 on a v5e-8 (models/configs.py, ops/quant_matmul.py).
+    weight_quant: Optional[str] = None
     checkpoint_every: int = 20  # profiles between sweep checkpoints (reference: 20)
     profile_trace_dir: Optional[str] = None  # jax.profiler trace output
 

@@ -83,7 +83,7 @@ def _pad_to_dp(mesh: Mesh, counts: np.ndarray, owners: List[int]):
     return counts, np.asarray(owners, np.int32)
 
 
-def _mesh_group_counts_fn(mesh: Mesh):
+def mesh_group_counts_fn(mesh: Mesh):
     """A ``group_counts_fn`` (see ``metrics.fairness.demographic_parity``)
     that reduces [N, V] -> [G, V] on device via psum over dp. Everything
     around the reduction — interning, kernels, detail formatting — is the
@@ -110,7 +110,7 @@ def demographic_parity_on_mesh(
     from fairness_llm_tpu.metrics.fairness import demographic_parity
 
     return demographic_parity(
-        recommendations_by_group, group_counts_fn=_mesh_group_counts_fn(mesh)
+        recommendations_by_group, group_counts_fn=mesh_group_counts_fn(mesh)
     )
 
 
@@ -125,5 +125,5 @@ def equal_opportunity_on_mesh(
 
     return equal_opportunity(
         recommendations_by_group, relevant_items,
-        group_counts_fn=_mesh_group_counts_fn(mesh),
+        group_counts_fn=mesh_group_counts_fn(mesh),
     )

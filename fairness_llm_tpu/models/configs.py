@@ -53,13 +53,14 @@ class ModelConfig:
     kv_cache_quant: bool = False
     # Pallas fused decode-step attention (ops/decode_attention.py): keeps the
     # per-layer scores/softmax/PV in VMEM instead of XLA's separate fusions.
-    # MEASURED SLOWER on the 45-profile sweep (104 vs 112 profiles/s on v5e;
-    # the head-major layout transposes cost more than the fusion boundaries
-    # save — docs/PERFORMANCE.md round 3), so it is OFF by default; kept as
-    # correct, oracle-tested groundwork (a native head-major cache layout is
-    # the follow-up that could flip the sign). Applies only on TPU to
-    # single-token cached steps with compatible shapes (no sliding window,
-    # no int8 cache); all other paths use XLA regardless.
+    # MEASURED SLOWER both ways and OFF by default: bf16 104 vs 112
+    # profiles/s at batch 48 (round 3); int8-cache mode (dequant-in-tile,
+    # round 4) 0.28x XLA at batch 192/360 — the per-step head-major cache
+    # transposes dominate, and the native head-major layout was also
+    # measured and rejected (docs/PERFORMANCE.md). Kept oracle-tested; the
+    # bench A/Bs it every round. Applies only on TPU to single-token cached
+    # steps with compatible shapes (no sliding window); all other paths use
+    # XLA regardless.
     use_decode_attention_kernel: bool = False
     # Weight-only quantization for serving: "int8" stores every 2D matmul
     # kernel (q/k/v/o, gate/up/down, untied lm_head) as int8 with per-output-

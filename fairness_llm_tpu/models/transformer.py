@@ -355,12 +355,13 @@ class Attention(nn.Module):
         """Static gate for the fused decode-attention kernel: TPU, a cached
         SINGLE-token step (key_valid alone encodes causality there), XLA-path
         semantics (no ring), no sliding window (mask not implemented in the
-        kernel), no int8 cache (dequantized arrays would defeat the memory
-        story), and tile-compatible shapes."""
+        kernel), and tile-compatible shapes. An int8 cache takes the
+        dequant-in-tile kernel mode (the kernel streams int8 + scales, so
+        its VMEM envelope is ~4x the f32 accounting)."""
         cfg = self.config
         if not (cfg.use_decode_attention_kernel and seq_len == 1 and cache_layer is not None):
             return False
-        if cfg.sliding_window is not None or cfg.kv_cache_quant:
+        if cfg.sliding_window is not None:
             return False
         if cfg.attention_impl != "xla" or jax.default_backend() != "tpu":
             return False
@@ -371,7 +372,13 @@ class Attention(nn.Module):
             return False
         from fairness_llm_tpu.ops.decode_attention import decode_attn_supported
 
-        return decode_attn_supported(batch, cache_len, cfg.head_dim, shared_len)
+        if cfg.kv_cache_quant:
+            itemsize = 1
+        else:
+            itemsize = 2 if cfg.dtype == "bfloat16" else 4
+        return decode_attn_supported(
+            batch, cache_len, cfg.head_dim, shared_len, kv_itemsize=itemsize
+        )
 
     @nn.compact
     def __call__(
@@ -478,12 +485,25 @@ class Attention(nn.Module):
             # causality is already encoded for S == 1).
             from fairness_llm_tpu.ops.decode_attention import decode_attention
 
-            out = decode_attention(
-                q[:, 0], keys.astype(dtype), values.astype(dtype), key_valid,
-                shared_kv=None if shared_kv is None else (
-                    shared_kv[0].astype(dtype), shared_kv[1].astype(dtype)
-                ),
-            )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
+            sh = None if shared_kv is None else (
+                shared_kv[0].astype(dtype), shared_kv[1].astype(dtype)
+            )
+            if cfg.kv_cache_quant:
+                # Raw int8 cache + scales straight into the kernel; the
+                # dequantized `keys`/`values` computed above are unused in
+                # this branch and get dead-code-eliminated, so the step
+                # streams HALF the cache bytes of the bf16 path.
+                out = decode_attention(
+                    q[:, 0], new_cache_layer.k, new_cache_layer.v, key_valid,
+                    shared_kv=sh,
+                    k_scale=new_cache_layer.k_scale,
+                    v_scale=new_cache_layer.v_scale,
+                )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
+            else:
+                out = decode_attention(
+                    q[:, 0], keys.astype(dtype), values.astype(dtype), key_valid,
+                    shared_kv=sh,
+                )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
         else:
             if cache_layer is not None:
                 K = keys.shape[1]

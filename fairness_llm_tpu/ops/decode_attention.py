@@ -9,22 +9,34 @@ kernel computes one head per grid step entirely in VMEM: QK^T, joint
 (shared-prefix + own-cache) online softmax, PV — nothing intermediate
 touches HBM.
 
+int8 cache mode (round 4): with ``kv_cache_quant`` the cache stores int8
+values + per-(slot, head) f32 scales; the kernel streams the int8 blocks
+and dequantizes IN VMEM. The per-slot scale commutes with the head_dim
+reduction, so dequant costs two [B, block] elementwise multiplies (fold
+k_scale into the scores, v_scale into the probabilities), never a scaled
+[B, block, D] temporary — and HBM sees half the bytes of the bf16 cache.
+The XLA int8 path instead relies on fusing ``dequant -> attention``, which
+the round-3 trace shows it does imperfectly (separate fusions per stage).
+
 Layout contract (head-major, so each grid step's block is a legal TPU tile —
 dynamic head indexing on the sublane dim is forbidden, so the wrapper
 transposes to head-leading layouts; the transposes are step-local copies
 XLA fuses into the cache-update neighborhood):
 - q: [B, H, D] -> kernel sees [H, B, D], one [1, B, D] block per head
 - k/v: [B, L, Hkv, D] -> [Hkv, B, L, D], GQA head h reads block h // rep
+- k/v_scale (int8 mode): [B, L, Hkv] f32 -> [Hkv, B, L]
 - valid: [B, L] bool — which cache slots hold real keys; for single-token
   decode this already encodes causality (slots after the write index are
   False), so it is the ONLY own-cache mask
 - shared_k/v: [P, Hkv, D] -> [Hkv, P128, D] — optional prompt prefix common
   to every row, always causally visible; padded to a 128 multiple
   (loop-invariant: XLA hoists the pad+transpose out of the decode
-  while_loop), masked by the true P inside the kernel
+  while_loop), masked by the true P inside the kernel. The prefix KV is
+  bf16 even in int8-cache mode (it is read once per step, not per row —
+  see runtime/engine._prefix_fn).
 
 Supported when D % 64 == 0, L % 128 == 0, B % 8 == 0 (else callers fall back
-to the XLA path). Sliding windows and the int8 cache use the XLA path.
+to the XLA path). Sliding windows use the XLA path.
 """
 
 from __future__ import annotations
@@ -40,36 +52,76 @@ NEG_INF = -1e30
 _BLOCK_L = 128  # own-cache block size (flash-style L iteration)
 
 
+def _block_bytes(bb: int, cache_len: int, head_dim: int, shared_len: int,
+                 kv_itemsize: int) -> int:
+    """Scoped-VMEM bytes one (head, batch-block) grid step needs: the
+    [1, bb, L, D] k and v block refs (plus their [bb, L] f32 scales in int8
+    mode), the f32 shared-prefix operands (the shared matmul is UNBLOCKED —
+    sk/sv cast whole plus [bb, P128] scores), and the kernel body's f32
+    temporaries — ~six [bb, 128, D] tensors live across the fori body
+    (kb/vb casts, the q*kb product, p, and the PV expansion). The temp term
+    is calibrated against Mosaic's own OOM report (bb=120 int8 L=256 D=64:
+    predicted 27.8 MB vs reported 27.73 MB)."""
+    p128 = -(-shared_len // 128) * 128
+    kv = 2 * bb * cache_len * head_dim * kv_itemsize
+    if kv_itemsize == 1:
+        kv += 2 * bb * cache_len * 4  # the f32 scales
+    shared = 2 * p128 * head_dim * 4 * 2 + bb * p128 * 4 * 3
+    temps = 6 * bb * _BLOCK_L * head_dim * 4
+    return kv + shared + temps
+
+
+def _pick_batch_block(batch: int, cache_len: int, head_dim: int,
+                      shared_len: int, kv_itemsize: int) -> int:
+    """Largest batch block (multiple of 8, dividing batch) whose grid step
+    fits the 16 MB scoped-VMEM window (minus 1 MB slack); 0 if even 8 rows
+    don't fit. Rows are independent, so blocking the batch is free
+    parallelism — it's what keeps the kernel eligible at batch 192/360
+    where a whole-batch block would blow VMEM."""
+    budget = 15 * 1024 * 1024
+    best = 0
+    for bb in range(8, batch + 1, 8):
+        if batch % bb:
+            continue
+        if _block_bytes(bb, cache_len, head_dim, shared_len, kv_itemsize) <= budget:
+            best = bb
+    return best
+
+
 def decode_attn_supported(
     batch: int, cache_len: int, head_dim: int, shared_len: int = 0,
+    kv_itemsize: int = 4,
 ) -> bool:
+    """Static shape gate + VMEM budget for the fused decode kernel.
+
+    ``kv_itemsize``: bytes/element the k and v BLOCKS occupy in VMEM — 4 for
+    the conservative f32-input default (bf16 callers may pass 2; int8-cache
+    callers pass 1, which roughly quadruples the eligible shape envelope).
+    """
     if not (batch % 8 == 0 and cache_len % _BLOCK_L == 0 and head_dim % 64 == 0):
         return False
-    # VMEM bound: each grid step holds whole [1, B, L, D] k and v blocks
-    # (double-buffered), the f32 shared-prefix operands (the shared matmul is
-    # UNBLOCKED — sk/sv cast whole plus [B, P128] scores), and f32 scratch,
-    # inside the 16 MB scoped budget; a tile-compatible but oversized shape
-    # must fall back to XLA, not crash Mosaic. 4 bytes/elt is the
-    # conservative (f32-input) width.
-    p128 = -(-shared_len // 128) * 128
-    kv_block_bytes = 2 * batch * cache_len * head_dim * 4
-    shared_bytes = 2 * p128 * head_dim * 4 * 2 + batch * p128 * 4 * 3
-    return kv_block_bytes + shared_bytes <= 8 * 1024 * 1024
+    return _pick_batch_block(batch, cache_len, head_dim, shared_len, kv_itemsize) > 0
 
 
 def _kernel(
     q_ref,  # [1, B, D]
-    k_ref,  # [1, B, L, D]
+    k_ref,  # [1, B, L, D] (model dtype, or int8 in quant mode)
     v_ref,  # [1, B, L, D]
     valid_ref,  # [B, L] int32
-    *rest,  # ([1, P128, D] sk, sv when shared) + o_ref [1, B, D]
+    *rest,  # ([1, B, L] ks, vs when quant) + ([1, P128, D] sk, sv when shared) + o_ref
     scale: float,
     shared_len: int,
+    quant: bool,
 ):
+    rest = list(rest)
+    ks_ref = vs_ref = sk_ref = sv_ref = None
+    if quant:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
     if shared_len:
-        sk_ref, sv_ref, o_ref = rest
-    else:
-        o_ref = rest[0]
+        sk_ref, sv_ref = rest[0], rest[1]
+        rest = rest[2:]
+    o_ref = rest[0]
 
     B = q_ref.shape[1]
     D = q_ref.shape[2]
@@ -106,12 +158,20 @@ def _kernel(
         mask = valid_ref[:, pl.ds(lb * _BLOCK_L, _BLOCK_L)] != 0  # [B, bl]
         # batched matvec as a VPU multiply-reduce, all in VMEM
         s = jnp.sum(q[:, None, :] * kb, axis=-1)  # [B, bl]
+        if quant:
+            # per-slot k scale commutes with the D-reduction: scale the
+            # SCORES, not the [B, bl, D] key block
+            s = s * ks_ref[0, :, pl.ds(lb * _BLOCK_L, _BLOCK_L)]
         s = jnp.where(mask, s, NEG_INF)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_acc, m_blk)
         p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
         alpha = jnp.exp(m_acc - m_new)
-        l_new = l_acc * alpha + jnp.sum(p, axis=1)
+        l_new = l_acc * alpha + jnp.sum(p, axis=1)  # normalizer: UNSCALED p
+        if quant:
+            # v scale likewise commutes with the slot reduction: fold it
+            # into the probabilities used for PV (only), not into vb
+            p = p * vs_ref[0, :, pl.ds(lb * _BLOCK_L, _BLOCK_L)]
         acc = acc * alpha[:, None] + jnp.sum(p[:, :, None] * vb, axis=1)
         return m_new, l_new, acc
 
@@ -122,18 +182,28 @@ def _kernel(
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def decode_attention(
     q: jnp.ndarray,  # [B, H, D]
-    k: jnp.ndarray,  # [B, L, Hkv, D]
+    k: jnp.ndarray,  # [B, L, Hkv, D] (int8 when scales given)
     v: jnp.ndarray,
     valid: jnp.ndarray,  # [B, L] bool
     shared_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,  # ([P, Hkv, D]) x2
+    k_scale: Optional[jnp.ndarray] = None,  # [B, L, Hkv] f32 (int8 cache mode)
+    v_scale: Optional[jnp.ndarray] = None,
     interpret: bool = False,
 ) -> jnp.ndarray:  # [B, H, D]
     B, H, D = q.shape
     L = k.shape[1]
     Hkv = k.shape[2]
     rep = H // Hkv
-    if not decode_attn_supported(B, L, D):
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("int8 cache mode needs both k_scale and v_scale")
+    shared_true_len = 0 if shared_kv is None else shared_kv[0].shape[0]
+    # Account k/v VMEM at the width actually streamed (bf16 callers get the
+    # 2-byte envelope, matching the model gate's accounting).
+    itemsize = 1 if quant else jnp.dtype(k.dtype).itemsize
+    if not decode_attn_supported(B, L, D, shared_true_len, kv_itemsize=itemsize):
         raise ValueError(f"unsupported decode-attention shape B={B} L={L} D={D}")
+    bb = _pick_batch_block(B, L, D, shared_true_len, itemsize)
     scale = D ** -0.5
 
     qh = q.transpose(1, 0, 2)  # [H, B, D]
@@ -141,11 +211,20 @@ def decode_attention(
     vh = v.transpose(2, 0, 1, 3)
     args = [qh, kh, vh, valid.astype(jnp.int32)]
     in_specs = [
-        pl.BlockSpec((1, B, D), lambda h: (h, 0, 0)),
-        pl.BlockSpec((1, B, L, D), lambda h: (h // rep, 0, 0, 0)),
-        pl.BlockSpec((1, B, L, D), lambda h: (h // rep, 0, 0, 0)),
-        pl.BlockSpec((B, L), lambda h: (0, 0)),
+        pl.BlockSpec((1, bb, D), lambda h, b: (h, b, 0)),
+        pl.BlockSpec((1, bb, L, D), lambda h, b: (h // rep, b, 0, 0)),
+        pl.BlockSpec((1, bb, L, D), lambda h, b: (h // rep, b, 0, 0)),
+        pl.BlockSpec((bb, L), lambda h, b: (b, 0)),
     ]
+    if quant:
+        args += [
+            k_scale.transpose(2, 0, 1).astype(jnp.float32),  # [Hkv, B, L]
+            v_scale.transpose(2, 0, 1).astype(jnp.float32),
+        ]
+        in_specs += [
+            pl.BlockSpec((1, bb, L), lambda h, b: (h // rep, b, 0)),
+            pl.BlockSpec((1, bb, L), lambda h, b: (h // rep, b, 0)),
+        ]
 
     if shared_kv is not None and shared_kv[0].shape[0] == 0:
         # A zero-length prefix is the no-prefix case; passing empty refs
@@ -161,18 +240,22 @@ def decode_attention(
             sv = jnp.pad(sv, ((0, pad), (0, 0), (0, 0)))
         p128 = sk.shape[0]
         args += [sk.transpose(1, 0, 2), sv.transpose(1, 0, 2)]  # [Hkv, P128, D]
+        # b-invariant index: consecutive batch-block grid steps revisit the
+        # same prefix block, so Pallas doesn't re-DMA it per step.
         in_specs += [
-            pl.BlockSpec((1, p128, D), lambda h: (h // rep, 0, 0)),
-            pl.BlockSpec((1, p128, D), lambda h: (h // rep, 0, 0)),
+            pl.BlockSpec((1, p128, D), lambda h, b: (h // rep, 0, 0)),
+            pl.BlockSpec((1, p128, D), lambda h, b: (h // rep, 0, 0)),
         ]
 
-    kernel = functools.partial(_kernel, scale=scale, shared_len=shared_len)
+    kernel = functools.partial(
+        _kernel, scale=scale, shared_len=shared_len, quant=quant
+    )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((H, B, D), q.dtype),
-        grid=(H,),
+        grid=(H, B // bb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, B, D), lambda h: (h, 0, 0)),
+        out_specs=pl.BlockSpec((1, bb, D), lambda h, b: (h, b, 0)),
         interpret=interpret,
     )(*args)
     return out.transpose(1, 0, 2)  # [B, H, D]

@@ -287,6 +287,12 @@ def backend_for(
     from fairness_llm_tpu.runtime.engine import DecodeEngine
 
     model_config = get_model_config(model_name)
+    if getattr(config, "weight_quant", None) is not None:
+        # Explicit override in EITHER direction: "int8" quantizes a float
+        # config, "none" forces float serving for e.g. llama3-70b-int8.
+        import dataclasses as _dc
+
+        model_config = _dc.replace(model_config, weight_quant=config.weight_quant)
     mesh = None
     if config.mesh.num_devices > 1:
         mesh = make_mesh(config.mesh)

@@ -65,10 +65,63 @@ def test_supported_gate():
     assert not decode_attn_supported(45, 256, 64)  # batch not 8-multiple
     assert not decode_attn_supported(48, 224, 64)  # cache not 128-multiple
     assert not decode_attn_supported(48, 256, 48)  # head_dim not 64-multiple
-    assert not decode_attn_supported(48, 2048, 64)  # kv blocks over VMEM budget
+    # batch-blocking keeps big batches eligible (rows are independent);
+    # the per-block VMEM budget still bounds cache length x head_dim
+    assert decode_attn_supported(192, 384, 64)
+    assert decode_attn_supported(360, 256, 64, kv_itemsize=1)
+    assert not decode_attn_supported(48, 4096, 64)  # 8-row block over budget
+    assert decode_attn_supported(48, 4096, 64, kv_itemsize=1)  # int8: half bytes
     assert decode_attn_supported(48, 256, 64, shared_len=704)  # the sweep shape
     # a multi-thousand-token shared prefix joins the VMEM accounting
     assert not decode_attn_supported(48, 256, 64, shared_len=30000)
+
+
+def test_batch_block_choice():
+    from fairness_llm_tpu.ops.decode_attention import _pick_batch_block
+
+    # whole batch when it fits; largest dividing 8-multiple otherwise
+    assert _pick_batch_block(48, 256, 64, 0, 4) == 48
+    bb = _pick_batch_block(360, 256, 64, 0, 1)
+    assert bb > 0 and 360 % bb == 0 and bb % 8 == 0 and bb < 360
+
+
+@pytest.mark.parametrize("shared_p", [None, 96])
+def test_kernel_int8_cache_matches_dequant_oracle(shared_p):
+    """int8-cache mode: the kernel must equal dense attention over the
+    DEQUANTIZED cache (scale-folding into scores/probs is exact math, so
+    tolerance is float rounding, not quantization error)."""
+    rng = np.random.default_rng(2)
+    B, H, hkv, D, L = 8, 4, 2, 64, 256
+    q = jnp.asarray(rng.normal(size=(B, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    valid = jnp.asarray(rng.random((B, L)) < 0.5).at[:, 0].set(True)
+
+    from fairness_llm_tpu.models.transformer import _dequantize_kv, _quantize_kv
+
+    qk, ks = _quantize_kv(k)
+    qv, vs = _quantize_kv(v)
+    shared = None
+    if shared_p:
+        sk = jnp.asarray(rng.normal(size=(shared_p, hkv, D)).astype(np.float32))
+        sv = jnp.asarray(rng.normal(size=(shared_p, hkv, D)).astype(np.float32))
+        shared = (sk, sv)
+    got = decode_attention(
+        q, qk, qv, valid, shared, k_scale=ks, v_scale=vs, interpret=True
+    )
+    want = _oracle(
+        q, _dequantize_kv(qk, ks, jnp.float32), _dequantize_kv(qv, vs, jnp.float32),
+        valid, *(shared or (None, None)),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_kernel_int8_requires_both_scales():
+    q = jnp.zeros((8, 4, 64), jnp.float32)
+    k = jnp.zeros((8, 128, 2, 64), jnp.int8)
+    valid = jnp.ones((8, 128), bool)
+    with pytest.raises(ValueError, match="k_scale and v_scale"):
+        decode_attention(q, k, k, valid, k_scale=jnp.ones((8, 128, 2)), interpret=True)
 
 
 def test_zero_length_prefix_is_no_prefix():

@@ -297,6 +297,71 @@ def test_train_step_rejects_quant_config():
         make_train_step(qcfg)
 
 
+def test_backend_for_weight_quant_override(tmp_path, tiny_quant):
+    """config.weight_quant='int8' (CLI --weight-quant) must route a real
+    checkpoint through quantize-at-load and serve greedy-identically to an
+    explicitly quantized engine."""
+    import dataclasses as dc
+
+    from fairness_llm_tpu.config import default_config
+    from fairness_llm_tpu.pipeline.backends import EngineBackend, backend_for
+    from fairness_llm_tpu.runtime.weights import save_checkpoint_hf
+
+    cfg, qcfg, params, qparams = tiny_quant
+    ckpt = tmp_path / "tiny-test"
+    ckpt.mkdir()
+    save_checkpoint_hf(cfg, params, str(ckpt))
+    # tokenizer files: backend_for needs none for tiny-test (byte tokenizer)
+    conf = dc.replace(
+        default_config(), weights_dir=str(tmp_path), weight_quant="int8"
+    )
+    backend = backend_for("tiny-test", conf)
+    assert isinstance(backend, EngineBackend)
+    assert backend.engine.config.weight_quant == "int8"
+    assert backend.engine.params["layer_0"]["attn"]["q_proj"]["kernel_q"].dtype == jnp.int8
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_int8_load_path(tmp_path, seed):
+    """Fuzz the quantize-at-load path: random weight trees (including
+    adversarial values — zeros, huge magnitudes, denormals, +-inf-free
+    extremes) must round-trip load->quantize->serve without NaN/Inf logits
+    or crashes, and scales must stay finite/positive."""
+    import dataclasses as dc
+
+    from fairness_llm_tpu.runtime.weights import load_checkpoint, save_checkpoint_hf
+
+    rng = np.random.default_rng(seed)
+    cfg = get_model_config("tiny-test")
+    qcfg = dc.replace(cfg, weight_quant="int8")
+    params = init_params(cfg, jax.random.key(seed))
+
+    def mutate(x):
+        x = np.asarray(x, np.float32).copy()
+        mode = rng.integers(0, 4)
+        if mode == 0:
+            x[:] = 0.0  # all-zero kernel -> zero scale guard
+        elif mode == 1:
+            x *= 1e30  # huge magnitudes -> scale overflow guard
+        elif mode == 2:
+            x *= 1e-38  # denormal-range -> scale underflow guard
+        return jnp.asarray(x)
+
+    params = jax.tree.map(mutate, params)
+    d = tmp_path / "fuzz"
+    d.mkdir()
+    save_checkpoint_hf(cfg, params, str(d))
+    loaded = load_checkpoint(qcfg, str(d), dtype=jnp.float32)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(loaded)[0]:
+        name = getattr(path[-1], "key", "")
+        arr = np.asarray(leaf)
+        if name == "kernel_scale":
+            assert np.isfinite(arr).all() and (arr > 0).all(), path
+        if name == "kernel_q":
+            assert arr.dtype == np.int8
+            assert (np.abs(arr.astype(np.int32)) <= 127).all()
+
+
 # ---------------------------------------------------------------------------
 # 70B capacity accounting (cheap, analytic — the compiled-program proof runs
 # on the TPU topology in tools/prove_70b_int8_fit.py / bench.py)

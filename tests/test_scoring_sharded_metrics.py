@@ -81,7 +81,7 @@ def test_mesh_group_counts_fn_randomized(eight_device_mesh):
     through the psum reduction == host wrappers on randomized rec lists of
     UNEVEN lengths and group sizes (incl. an empty group)."""
     from fairness_llm_tpu.metrics import equal_opportunity
-    from fairness_llm_tpu.metrics.sharded import _mesh_group_counts_fn
+    from fairness_llm_tpu.metrics.sharded import mesh_group_counts_fn
 
     rng = np.random.default_rng(7)
     items = [f"title {i}" for i in range(30)]
@@ -94,7 +94,7 @@ def test_mesh_group_counts_fn_randomized(eight_device_mesh):
             )
     relevant = {items[i] for i in range(0, 30, 4)}
 
-    fn = _mesh_group_counts_fn(eight_device_mesh)
+    fn = mesh_group_counts_fn(eight_device_mesh)
     dp_s, det_s = demographic_parity(recs_by_group, group_counts_fn=fn)
     dp_h, det_h = demographic_parity(recs_by_group)
     np.testing.assert_allclose(dp_s, dp_h, atol=1e-5)
