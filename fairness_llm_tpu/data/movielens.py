@@ -42,6 +42,10 @@ class MovieLensData:
     rating_movie_ids: np.ndarray  # int32 [R]
     rating_values: np.ndarray  # float32 [R]
     synthetic: bool = False
+    # "real" (all tables from disk) | "real-catalog+synthetic-ratings" (the
+    # committed-snapshot mode: movies.dat/users.dat are the true ML-1M
+    # tables, ratings seeded-synthetic over the real ids) | "synthetic"
+    source: str = "real"
 
     @property
     def num_movies(self) -> int:
@@ -50,6 +54,16 @@ class MovieLensData:
     @property
     def num_ratings(self) -> int:
         return len(self.rating_values)
+
+    def provenance(self) -> Dict[str, object]:
+        """Corpus identity for result metadata — committed records pin THIS
+        (source + table sizes) instead of requiring the data to be absent
+        (round-3 verdict: golden-record fragility by design)."""
+        return {
+            "source": self.source,
+            "num_movies": int(self.num_movies),
+            "num_ratings": int(self.num_ratings),
+        }
 
     def title_of(self) -> Dict[int, str]:
         return dict(zip(self.movie_ids.tolist(), self.titles))
@@ -96,14 +110,16 @@ def load_movielens(data_dir: str, allow_synthetic: bool = True, seed: int = 42) 
     counterfactual users (reference behavior — ``users.dat`` is loaded but never
     consumed downstream of ``load_movielens_data``).
 
-    Missing files trigger the synthetic fallback (reference
-    ``run_phase1``/``phase1_bias_detection.py:288-306``) unless
-    ``allow_synthetic=False``.
+    Missing movies.dat triggers the fully-synthetic fallback (reference
+    ``run_phase1``/``phase1_bias_detection.py:288-306``); movies.dat present
+    but ratings.dat missing triggers the MIXED mode (real catalog + seeded
+    synthetic ratings). ``allow_synthetic=False`` demands the fully-real
+    corpus and raises in both fallback cases.
     """
     movies_path = os.path.join(data_dir, "movies.dat")
     ratings_path = os.path.join(data_dir, "ratings.dat")
 
-    if not os.path.exists(movies_path) or not os.path.exists(ratings_path):
+    if not os.path.exists(movies_path):
         if not allow_synthetic:
             raise FileNotFoundError(f"MovieLens data not found under {data_dir}")
         logger.warning("MovieLens data missing under %s — using synthetic fallback", data_dir)
@@ -114,10 +130,31 @@ def load_movielens(data_dir: str, allow_synthetic: bool = True, seed: int = 42) 
     titles = [r[1] for r in movie_rows]
     genres = [r[2].split("|") for r in movie_rows]
 
-    r_users, r_movies, r_values = _parse_ratings(ratings_path)
+    if os.path.exists(ratings_path):
+        r_users, r_movies, r_values = _parse_ratings(ratings_path)
+        source = "real"
+    elif not allow_synthetic:
+        # Strict callers demand the fully-real corpus: substituted ratings
+        # (however seeded) are still synthetic data.
+        raise FileNotFoundError(f"ratings.dat not found under {data_dir}")
+    else:
+        # Mixed mode: the REAL catalog (movies.dat ships in the snapshot;
+        # only the 24 MB ratings.dat is stripped) with seeded synthetic
+        # ratings over the real movie ids — real titles exercise the
+        # canonicalizer and real genres drive the phase-2 queries, while the
+        # substituted table follows the reference's ratings schema
+        # (phase1_bias_detection.py:40-46) and stays deterministic.
+        logger.warning(
+            "ratings.dat missing under %s — real catalog (%d movies) with "
+            "seeded synthetic ratings", data_dir, len(movie_ids),
+        )
+        r_users, r_movies, r_values = synthetic_ratings(movie_ids, seed=seed)
+        source = "real-catalog+synthetic-ratings"
 
     logger.info("Loaded MovieLens: %d movies, %d ratings", len(movie_ids), len(r_values))
-    return MovieLensData(movie_ids, titles, genres, r_users, r_movies, r_values)
+    return MovieLensData(
+        movie_ids, titles, genres, r_users, r_movies, r_values, source=source
+    )
 
 
 # Genre pool for the synthetic corpus (the 18 MovieLens-1M genres).
@@ -126,6 +163,34 @@ _GENRES = [
     "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
     "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
 ]
+
+
+def synthetic_ratings(
+    movie_ids: np.ndarray,
+    num_users: int = 6040,
+    ratings_per_user: int = 165,
+    seed: int = 42,
+):
+    """Seeded ratings over a given (real) movie-id catalog.
+
+    Defaults match ML-1M's true proportions (6,040 users, ~1M ratings —
+    ~257 per movie on the 3,883-movie catalog), so downstream popularity
+    filters (phase-2's ``min_ratings=20``) behave as they would on the real
+    table. Same generative shape as the fully-synthetic corpus: a random
+    third of the catalog is "good" (skewed >= 4.0) so the quality filter
+    keeps a nontrivial pool.
+    """
+    rng = np.random.default_rng(seed)
+    r_users = np.repeat(np.arange(1, num_users + 1, dtype=np.int32), ratings_per_user)
+    r_movies = rng.choice(movie_ids, size=num_users * ratings_per_user).astype(np.int32)
+    good = rng.choice(movie_ids, size=max(1, len(movie_ids) // 3), replace=False)
+    is_good = np.isin(r_movies, good)
+    r_values = np.where(
+        is_good,
+        rng.choice([4.0, 4.5, 5.0], size=r_users.shape),
+        rng.choice([2.0, 2.5, 3.0, 3.5, 4.0], size=r_users.shape),
+    ).astype(np.float32)
+    return r_users, r_movies, r_values
 
 
 def synthetic_movielens(

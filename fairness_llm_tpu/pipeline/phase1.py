@@ -234,9 +234,9 @@ def run_phase1(
     qualified = set(qualified_movies(data, seed=config.random_seed))
     counts_fn = None
     if use_device_reduction:
-        from fairness_llm_tpu.metrics.sharded import _mesh_group_counts_fn
+        from fairness_llm_tpu.metrics.sharded import mesh_group_counts_fn
 
-        counts_fn = _mesh_group_counts_fn(mesh)
+        counts_fn = mesh_group_counts_fn(mesh)
     dp_gender, dp_gender_detail = measure_demographic_parity(by_gender, counts_fn)
     dp_age, dp_age_detail = measure_demographic_parity(by_age, counts_fn)
     eo_score, eo_rates = measure_equal_opportunity(by_gender, qualified, counts_fn)
@@ -273,6 +273,10 @@ def run_phase1(
             # provenance of the DP/EO reduction: "dp-psum" = on-device over the
             # mesh the sweep decoded on; "host" = single-device numpy+jit path
             "metric_reduction": "dp-psum" if use_device_reduction else "host",
+            # corpus identity — committed records pin THIS (regression tests
+            # compare only when provenance matches) instead of requiring the
+            # ML-1M data to be absent
+            "corpus": data.provenance(),
         },
         "profiles": [p.to_dict() for p in profiles],
         "recommendations": {

@@ -348,16 +348,25 @@ def compare_models_and_methods(model_results: Dict[str, Dict]) -> Dict:
 
 
 def build_corpus(
-    config: Config, corpus: str = "synthetic", num_items: int = 20
-) -> List[RankingItem]:
+    config: Config, corpus: str = "synthetic", num_items: int = 20,
+    with_provenance: bool = False,
+):
     """``synthetic``: the reference's 20-doc compat corpus. ``movielens``:
-    real ML-1M titles at configurable scale (genre-derived groups)."""
+    real ML-1M titles at configurable scale (genre-derived groups).
+    ``with_provenance=True`` returns ``(items, provenance_dict)`` so result
+    metadata can pin the corpus identity."""
     if corpus == "synthetic":
-        return create_synthetic_ranking_data(num_items, seed=config.random_seed)
-    if corpus == "movielens":
+        items = create_synthetic_ranking_data(num_items, seed=config.random_seed)
+        prov = {"source": "synthetic-ranking", "num_items": len(items)}
+    elif corpus == "movielens":
         data = load_movielens(config.data_dir, seed=config.random_seed)
-        return movielens_ranking_corpus(data, num_items, seed=config.random_seed)
-    raise ValueError(f"unknown corpus '{corpus}' (expected 'synthetic' or 'movielens')")
+        items = movielens_ranking_corpus(data, num_items, seed=config.random_seed)
+        prov = data.provenance()
+    else:
+        raise ValueError(
+            f"unknown corpus '{corpus}' (expected 'synthetic' or 'movielens')"
+        )
+    return (items, prov) if with_provenance else items
 
 
 def run_phase2(
@@ -374,7 +383,7 @@ def run_phase2(
     models = list(models or config.default_models_phase2)
     t0 = time.time()
 
-    items = build_corpus(config, corpus, num_items)
+    items, corpus_prov = build_corpus(config, corpus, num_items, with_provenance=True)
     catalog = [it.text for it in items]
 
     model_results = {}
@@ -402,6 +411,7 @@ def run_phase2(
             "phase": 2,
             "models": models,
             "corpus": corpus,
+            "corpus_provenance": corpus_prov,
             "num_items": len(items),
             "num_queries": num_queries,
             "num_comparisons": num_comparisons,

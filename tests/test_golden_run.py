@@ -31,13 +31,28 @@ def golden_phase1():
         return json.load(f)
 
 
-@pytest.fixture(scope="module")
-def fresh_phase1(tmp_path_factory):
-    if (DATA_DIR / "movies.dat").exists():
+def _require_matching_provenance(golden_meta):
+    """Records pin their corpus identity; compare only when the CURRENT
+    loader reproduces it (round-3 verdict: provenance pinning replaces the
+    old requires-data-ABSENT fragility). A mismatch means the data under
+    data/ml-1m changed (e.g. a real ratings.dat was added) — regenerate the
+    records per results/README.md instead of chasing numeric drift."""
+    from fairness_llm_tpu.data import load_movielens
+
+    want = golden_meta.get("corpus")
+    if want is None:
+        pytest.skip("committed record predates corpus provenance — regenerate")
+    have = load_movielens(str(DATA_DIR), seed=42).provenance()
+    if have != want:
         pytest.skip(
-            "real ML-1M present: the committed record was produced on the "
-            "synthetic fallback — regenerate results/ (see results/README.md)"
+            f"corpus provenance changed (record {want} vs current {have}) — "
+            "regenerate results/ (see results/README.md)"
         )
+
+
+@pytest.fixture(scope="module")
+def fresh_phase1(tmp_path_factory, golden_phase1):
+    _require_matching_provenance(golden_phase1["metadata"])
     config = Config(
         results_dir=str(tmp_path_factory.mktemp("golden")), data_dir=str(DATA_DIR)
     )
@@ -84,8 +99,9 @@ def test_phase2_movielens_at_scale_matches_committed_record(tmp_path):
     with open(path) as f:
         golden = json.load(f)
 
-    if (DATA_DIR / "movies.dat").exists():
-        pytest.skip("real ML-1M present: record was produced on the synthetic fallback")
+    _require_matching_provenance(
+        {"corpus": golden["metadata"].get("corpus_provenance")}
+    )
     config = Config(results_dir=str(tmp_path), data_dir=str(DATA_DIR))
     fresh = run_phase2(
         config, models=["simulated-fair", "simulated", "simulated-biased"],
