@@ -80,6 +80,10 @@ def run(model_name: str = "gpt2-small") -> dict:
         b["ms"] = round(b["ms"], 2)
 
     steps = MAX_NEW_TOKENS  # random weights never EOS: full trip count
+    all_ops = sorted(
+        ((round(ms, 3), cnt, name[:160]) for name, ms, cnt in s.top_ops),
+        reverse=True,
+    )[:150]
     table = sorted(buckets.items(), key=lambda kv: -kv[1]["ms"])
     result = {
         "model": model_name,
@@ -87,6 +91,7 @@ def run(model_name: str = "gpt2-small") -> dict:
         "num_events": s.num_events,
         "decode_steps": steps,
         "decode_shape": out.stats,
+        "top_ops": all_ops,
         "components": {
             label: {
                 "ms": b["ms"],
