@@ -225,11 +225,11 @@ class QuantDense(nn.Module):
         if mesh is None or all(s == 1 for s in mesh.shape.values()):
             y = quant_matmul(x2, wq, scale, out_dtype=out_dtype)
         else:
-            spec = nn.logical_to_mesh_axes(
-                (self.in_axis, self.out_axis, "batch", "seq")
-            )
+            from fairness_llm_tpu.parallel.sharding import resolve_logical_axis
+
             k_axis, n_axis, b_axis, s_axis = (
-                a if a and mesh.shape.get(a, 1) > 1 else None for a in tuple(spec)
+                resolve_logical_axis(a, mesh)
+                for a in (self.in_axis, self.out_axis, "batch", "seq")
             )
             if b_axis is not None and x2.shape[0] % mesh.shape[b_axis] != 0:
                 # batch=1 shared-prefix forward (rows = sequence positions),
@@ -292,15 +292,19 @@ class Attention(nn.Module):
 
     def _mesh_axes(self):
         """(batch, q_heads, kv_heads) mesh axes actually sharded (>1) under
-        the enclosing mesh + logical-rules context, else Nones."""
-        from fairness_llm_tpu.parallel.sharding import current_mesh
+        the enclosing mesh + logical-rules context, else Nones.
+
+        Axes resolve one at a time (``resolve_logical_axis``): a joint
+        PartitionSpec lookup may use each mesh axis only once, so q_heads
+        would claim "tp" and kv_heads silently resolve to None (observed:
+        the sharded flash gate quietly never engaged)."""
+        from fairness_llm_tpu.parallel.sharding import current_mesh, resolve_logical_axis
 
         mesh = current_mesh()
         if mesh is None:
             return None, None, None
-        spec = nn.logical_to_mesh_axes(("batch", "q_heads", "kv_heads"))
         return tuple(
-            a if a and mesh.shape.get(a, 1) > 1 else None for a in tuple(spec)
+            resolve_logical_axis(a, mesh) for a in ("batch", "q_heads", "kv_heads")
         )
 
     def _flash_dispatch(self, q, k, v, lengths):

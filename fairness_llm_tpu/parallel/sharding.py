@@ -68,6 +68,22 @@ def current_mesh() -> Optional[Mesh]:
     return None if m.empty else m
 
 
+def resolve_logical_axis(name: str, mesh: Mesh) -> Optional[str]:
+    """The mesh axis a SINGLE logical axis maps to under the enclosing flax
+    rules context, or None when unmapped / size 1.
+
+    One name at a time on purpose: a joint
+    ``logical_to_mesh_axes((a, b, ...))`` builds one PartitionSpec, where a
+    mesh axis may appear only once — querying q_heads and kv_heads together
+    silently resolves the second "tp" mapping to None (this bug once made
+    the sharded flash gate never engage).
+    """
+    import flax.linen as fnn
+
+    axis = tuple(fnn.logical_to_mesh_axes((name,)))[0]
+    return axis if axis and mesh.shape.get(axis, 1) > 1 else None
+
+
 def make_axis_rules(model_config: ModelConfig, mesh: Mesh) -> AxisRules:
     """Logical->mesh axis rules, dropping mappings that don't divide evenly.
 

@@ -157,6 +157,72 @@ def test_70b_decode_kv_cache_estimate():
     assert got == 2 * 80 * 48 * 1024 * 1 * 128 * 2
 
 
+def test_8b_flash_prefill_compiles_sharded_on_v5e_topology():
+    """tp=8 serving prefill with the FLASH kernel engaged, through the real
+    v5e compiler: the round-4 shard_map dispatch is what makes a Pallas
+    flash call legal inside a multi-chip program at all (Mosaic refuses
+    GSPMD-partitioned contexts — previously multi-chip prefill silently
+    required dense attention). 2 layers of llama3-8b's exact dims at
+    S=1024 (flash-eligible length, batch 8 over dp=2 x tp=4 so BOTH batch
+    and head sharding run through the wrap)."""
+    import dataclasses
+
+    import numpy as np
+
+    try:
+        from jax.experimental import topologies
+
+        td = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x4")
+    except Exception as e:  # noqa: BLE001 — no TPU compiler in this env
+        pytest.skip(f"TPU topology unavailable: {type(e).__name__}")
+    from fairness_llm_tpu.ops.quant_matmul import force_pallas
+
+    cfg = dataclasses.replace(
+        get_model_config("llama3-8b"), name="llama3-8b-2l", num_layers=2,
+    )
+    assert cfg.use_flash_attention
+    mesh = jax.sharding.Mesh(
+        np.array(td.devices).reshape(2, 4, 1), ("dp", "tp", "sp")
+    )
+    rules = shd.make_axis_rules(cfg, mesh)
+    shardings = shd.param_shardings(cfg, mesh, rules)
+    model = Transformer(cfg)
+    abstract = nn.meta.unbox(
+        jax.eval_shape(
+            model.init, jax.random.key(0),
+            jnp.zeros((1, 8), jnp.int32), jnp.zeros((1, 8), jnp.int32),
+        )["params"]
+    )
+    aparams = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16, sharding=s),
+        abstract, shardings,
+    )
+
+    B, S = 8, 1024
+
+    def prefill(params, tokens, positions, valid):
+        cache = init_cache(cfg, B, S + 1)
+        logits, _ = model.apply(
+            {"params": params}, tokens, positions, valid, cache,
+            left_padded=True, last_only=True,
+        )
+        return logits
+
+    bs = shd.batch_sharding(mesh)
+    atoks = jax.ShapeDtypeStruct((B, S), jnp.int32, sharding=bs)
+    avalid = jax.ShapeDtypeStruct((B, S), jnp.bool_, sharding=bs)
+    with mesh, nn.logical_axis_rules(rules), force_pallas():
+        # force_pallas makes _flash_ok treat the lowering target as TPU in
+        # this CPU-pinned test process; the wrap then must produce a program
+        # the actual TPU compiler accepts.
+        lowered = jax.jit(prefill).lower(aparams, atoks, atoks, avalid)
+        # the kernel must actually be IN the program (a silent dense
+        # fallback would also compile, proving nothing)
+        assert "tpu_custom_call" in lowered.as_text()
+        compiled = lowered.compile()
+    assert compiled.memory_analysis() is not None
+
+
 def test_70b_int8_layer_compiles_on_v5e_topology():
     """The int8 fit proof's LOWERING, at suite speed: a 2-layer model with
     llama3-70b's exact per-layer dimensions, int8 weights, tp=8, compiled by
