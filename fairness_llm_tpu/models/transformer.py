@@ -185,8 +185,9 @@ class QuantDense(nn.Module):
     hoisted out of the decode loop by XLA and materializes the full bf16
     tree; docs/PERFORMANCE.md round 3). When the enclosing ``with mesh:``
     context shards the kernel's logical axes, the matmul runs as a
-    partial-manual shard_map over those axes (column-parallel local,
-    row-parallel + psum), leaving dp/sp to GSPMD auto mode.
+    FULL-manual shard_map over every mesh axis (column-parallel local,
+    row-parallel + f32 psum, batch sharding encoded in the specs — Mosaic
+    kernels can't lower partially-auto; see quant_matmul_sharded).
     """
 
     features: int
@@ -281,7 +282,7 @@ class Attention(nn.Module):
             return False
         from fairness_llm_tpu.ops.quant_matmul import _FORCE_PALLAS
 
-        if jax.default_backend() != "tpu" and not _FORCE_PALLAS:
+        if jax.default_backend() != "tpu" and not _FORCE_PALLAS.get():
             return False
         _, qh_ax, kv_ax = self._mesh_axes()
         if qh_ax != kv_ax:

@@ -77,7 +77,17 @@ def _pick_batch_block(batch: int, cache_len: int, head_dim: int,
     fits the 16 MB scoped-VMEM window (minus 1 MB slack); 0 if even 8 rows
     don't fit. Rows are independent, so blocking the batch is free
     parallelism — it's what keeps the kernel eligible at batch 192/360
-    where a whole-batch block would blow VMEM."""
+    where a whole-batch block would blow VMEM.
+
+    NOTE the budget is intentionally NOT more conservative: the 48-row
+    sweep shape sits exactly at the 15 MiB boundary and has run whole-batch
+    on v5e since round 3 — extra slack would silently split a proven-live
+    configuration. Because ``_block_bytes``'s temp term is a calibrated
+    model (fitted to one Mosaic OOM report), a shape where it
+    under-predicts can still pass the gate and fail in Mosaic; the engine
+    catches that compile failure and retries with the kernel disabled
+    (DecodeEngine's VMEM-fallback), so a gate miss degrades to the XLA
+    path instead of failing the study."""
     budget = 15 * 1024 * 1024
     best = 0
     for bb in range(8, batch + 1, 8):

@@ -20,11 +20,12 @@ float32 accumulator and applies the scale once, on the final K step. The
 int8->bf16 cast is exact (|q| <= 127 << 2^8), making the kernel numerically
 equivalent to a bf16 matmul against the dequantized weights.
 
-Sharding: ``quant_matmul_sharded`` wraps the kernel in a partial-manual
-``jax.shard_map`` over the mesh axes that shard the weight — column-parallel
-(N sharded) runs purely locally; row-parallel (K sharded) adds the
-``psum`` that GSPMD would have inserted for the dense equivalent. Other
-mesh axes (dp batch sharding) stay in GSPMD "auto" mode. This is the
+Sharding: ``quant_matmul_sharded`` wraps the kernel in a FULL-manual
+``jax.shard_map`` over every mesh axis (Mosaic kernels refuse to lower in a
+partially-auto SPMD context) — column-parallel (N sharded) runs purely
+locally; row-parallel (K sharded) psums f32 partial products, the same
+collective GSPMD inserts for the dense equivalent; batch (dp) sharding is
+encoded in the specs rather than left to GSPMD. This is the
 trace-time-lowered integration (works under AOT topology compilation, where
 ``custom_partitioning``'s runtime callback is unavailable).
 
@@ -35,6 +36,7 @@ APIs, SURVEY.md §0); this is the capability that puts Llama-3-70B tp=8 — a
 
 from __future__ import annotations
 
+import contextvars
 import functools
 from typing import Optional, Tuple
 
@@ -59,19 +61,24 @@ _LANE = 128  # TPU lane width: last-dim tiling granule for every dtype
 # process's live backend, not the topology being lowered FOR — a CPU-pinned
 # test process AOT-compiling against a TPU topology descriptor must still
 # take the Pallas path (that's the thing being proven). Context-managed, not
-# an argument, because the call sites sit inside flax modules.
-_FORCE_PALLAS: list = []
+# an argument, because the call sites sit inside flax modules. A ContextVar
+# (not a module-level flag) so a concurrent trace in another thread — e.g.
+# a test runner compiling while the bench's AOT check runs — can't observe
+# this thread's override.
+_FORCE_PALLAS: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "quant_matmul_force_pallas", default=0
+)
 
 
 class force_pallas:
     """``with force_pallas():`` — treat the lowering target as TPU."""
 
     def __enter__(self):
-        _FORCE_PALLAS.append(True)
+        self._token = _FORCE_PALLAS.set(_FORCE_PALLAS.get() + 1)
         return self
 
     def __exit__(self, *exc):
-        _FORCE_PALLAS.pop()
+        _FORCE_PALLAS.reset(self._token)
         return False
 
 
@@ -183,7 +190,7 @@ def quant_matmul(
     then-scale) so both paths agree to float rounding, not just mathematically.
     """
     out_dtype = out_dtype or x.dtype
-    on_tpu = jax.default_backend() == "tpu" or bool(_FORCE_PALLAS)
+    on_tpu = jax.default_backend() == "tpu" or bool(_FORCE_PALLAS.get())
     if (on_tpu or interpret) and quant_tileable(*wq.shape):
         return _quant_matmul_pallas(x, wq, scale, interpret, out_dtype)
     y = jnp.dot(x, wq.astype(x.dtype), preferred_element_type=jnp.float32)
@@ -208,7 +215,11 @@ def quant_matmul_sharded(
     contraction / output dim; ``b_axis``: the axis sharding x's rows (dp).
     Column-parallel (n_axis) is purely local; row-parallel (k_axis) psums
     partial products — exactly the collective GSPMD inserts for the dense
-    row-parallel matmul.
+    row-parallel matmul. The row-parallel psum accumulates in float32 (each
+    shard's kernel output stays f32 until after the all-reduce) to match
+    the dense GSPMD path, which all-reduces the f32 dot output before the
+    downcast — casting shards to bf16 pre-psum would add avoidable
+    accumulation error at tp=8 (e.g. the 70B down_proj).
 
     Why full-manual: Mosaic kernels refuse to lower in a partially-auto
     SPMD context (``tpu_custom_call.py`` requires manual_axes == all mesh
@@ -219,10 +230,12 @@ def quant_matmul_sharded(
     out_dtype = out_dtype or x.dtype
 
     def local(xl, wql, scalel):
-        y = quant_matmul(xl, wql, scalel, interpret=interpret, out_dtype=out_dtype)
         if k_axis is not None:
-            y = jax.lax.psum(y, k_axis)
-        return y
+            y = quant_matmul(
+                xl, wql, scalel, interpret=interpret, out_dtype=jnp.float32
+            )
+            return jax.lax.psum(y, k_axis).astype(out_dtype)
+        return quant_matmul(xl, wql, scalel, interpret=interpret, out_dtype=out_dtype)
 
     return jax.shard_map(
         local,

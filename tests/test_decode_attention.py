@@ -152,3 +152,58 @@ def test_model_gate_off_by_default_and_off_paths():
     attn = Attention(on)
     # CPU backend -> gated off even when the flag is set
     assert not attn._decode_kernel_ok(1, object(), 48, 256)
+
+
+def test_engine_vmem_compile_fallback(monkeypatch):
+    """A Mosaic scoped-VMEM compile failure (the gate's calibrated byte
+    model under-predicting) must degrade the engine to the XLA attention
+    path, not fail generate(): the engine catches the error, disables the
+    kernel flag, recompiles once, and serves."""
+    import dataclasses
+
+    from fairness_llm_tpu.config import ModelSettings
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    cfg = dataclasses.replace(
+        get_model_config("tiny-test"), use_decode_attention_kernel=True
+    )
+    eng = DecodeEngine(cfg, seed=0)
+    real = DecodeEngine._decode_fn
+    state = {"raised": False}
+
+    def fake_decode_fn(self, *args, **kwargs):
+        if not state["raised"]:
+            state["raised"] = True
+
+            def boom(*a, **k):
+                raise RuntimeError(
+                    "Ran out of scoped vmem while compiling the kernel"
+                )
+
+            return boom
+        return real(self, *args, **kwargs)
+
+    monkeypatch.setattr(DecodeEngine, "_decode_fn", fake_decode_fn)
+    out = eng.generate(
+        ["hello there", "general kenobi"],
+        ModelSettings(temperature=0.0, max_tokens=4),
+        seed=0,
+    )
+    assert state["raised"]
+    assert not eng.config.use_decode_attention_kernel  # fell back
+    assert len(out.texts) == 2
+
+    # A non-VMEM error (or one with the kernel already off) still raises.
+    state["raised"] = False
+
+    def fake_other(self, *args, **kwargs):
+        def boom(*a, **k):
+            raise RuntimeError("unrelated failure")
+
+        return boom
+
+    monkeypatch.setattr(DecodeEngine, "_decode_fn", fake_other)
+    eng2 = DecodeEngine(cfg, seed=0)
+    with pytest.raises(RuntimeError, match="unrelated"):
+        eng2.generate(["x"], ModelSettings(temperature=0.0, max_tokens=2))
