@@ -227,8 +227,6 @@ def int8_70b_fit() -> dict | None:
     proving every kernel/shard_map/collective the artifact relies on still
     lowers today. TPU-compiler environments only.
     """
-    import importlib.util
-
     root = os.path.dirname(os.path.abspath(__file__))
     out: dict = {}
     try:
@@ -237,13 +235,7 @@ def int8_70b_fit() -> dict | None:
     except Exception:  # noqa: BLE001 — artifact optional
         out["full_model_committed"] = None
     try:
-        spec = importlib.util.spec_from_file_location(
-            "prove_70b_int8_fit",
-            os.path.join(root, "tools", "prove_70b_int8_fit.py"),
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        live = mod.prove(num_layers=2)
+        live = _load_tool("prove_70b_int8_fit").prove(num_layers=2)
         out["live_2layer_check"] = {
             "lowering_ok": True,
             "compile_s": live["compile_s"],
@@ -256,6 +248,84 @@ def int8_70b_fit() -> dict | None:
             "lowering_ok": False, "error": f"{type(e).__name__}: {e}"
         }
     return out
+
+
+def _load_tool(name: str):
+    """Import a measurement tool module from tools/ by file path."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(root, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def llama70b_shard_live() -> dict | None:
+    """Recurring per-round 70B decode rate (VERDICT r4 item 4): the tp=8
+    per-chip shard of llama3-70b-int8 decoded LIVE on this chip —
+    tools/measure_70b_shard.py's measurement folded into the bench so a
+    regression in the dequant-in-tile path's in-model rate (the round-4
+    number: 569 GB/s, at the chip's own bandwidth wall) surfaces in the
+    BENCH_r* record automatically instead of going stale in a one-off
+    proof. ~2-3 min: 8.9 GB engine init + two decode-length compiles.
+    TPU-only."""
+    if jax.default_backend() != "tpu":
+        return None
+    return _load_tool("measure_70b_shard").run(batch=8, new_tokens=32)
+
+
+def llama3_8b_live(achievable_gbps) -> dict | None:
+    """BASELINE configs[1] — Llama-3-8B — served WHOLE on this chip
+    (VERDICT r4 item 1, the first end-to-end >=7B full-model number):
+    tools/serve_8b_live.py's phase-1 sweep + phase-2 listwise, int8
+    dequant-in-tile weights (~8.6 GB of 15.75). The tool's own probe is
+    skipped; the ratio uses THIS run's achievable-bandwidth probe so every
+    operating point in the record is measured against the same wall."""
+    if jax.default_backend() != "tpu":
+        return None
+    res = _load_tool("serve_8b_live").run(include_probe=False)
+    ph1 = res.get("phase1_sweep")
+    if ph1 and achievable_gbps:
+        ph1["achievable_hbm_gbps_probe"] = round(achievable_gbps, 1)
+        ph1["achieved_over_achievable"] = round(
+            ph1["achieved_hbm_gbps"] / achievable_gbps, 3
+        )
+    return res
+
+
+def phase2_7b_committed() -> dict | None:
+    """Per-model summary of the committed 7B cross-model phase-2 record
+    (tools/run_7b_cross_model.py -> results/phase2/phase2_7b_results.json):
+    the BASELINE configs[2] set — mistral/qwen2/gemma at 7B, int8 weights —
+    evaluated live on the chip. Embedded here (the int8_70b_fit pattern) so
+    every BENCH_r* record carries the per-model numbers; the full eval
+    (~25 min of engine inits + compiles) is a tool run, not a per-bench
+    cost — regenerate with the tool when the serving path changes."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(root, "results", "phase2", "phase2_7b_results.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return {
+            "committed": True,
+            "metadata": {
+                k: rec["metadata"].get(k)
+                for k in ("models", "num_items", "num_queries",
+                          "num_comparisons", "elapsed_seconds", "device")
+            },
+            "per_model_perf": rec.get("per_model_perf"),
+            "model_fairness": rec.get("comparison", {}).get("model_fairness"),
+        }
+    except Exception as e:  # noqa: BLE001 — artifact optional, but say so
+        print(
+            f"phase2_7b committed record unavailable ({type(e).__name__}: {e}); "
+            f"regenerate with tools/run_7b_cross_model.py -> {path}",
+            file=sys.stderr,
+        )
+        return None
 
 
 def build_sweep_prompts():
@@ -359,6 +429,37 @@ def measure_phase2_listwise(config, settings_cls) -> dict | None:
         # same query count as the listwise measurement -> direct wall ratio
         "vs_listwise_decode": round(out["flash"]["wall_s"] / max(wall, 1e-9), 2),
     }
+
+    # Pairwise at scale: 200 comparisons over the same ML-1M corpus decoded
+    # as ONE batch — the reference's pairwise hot loop
+    # (phase2_cross_model_eval.py:165-210, 30 sequential API calls) at 6.7x
+    # its comparison budget, the last reference hot loop without an at-scale
+    # live number (VERDICT r4 weak item 3). Short decode cap: the answer is
+    # one letter; 16 tokens is the reference-compatible envelope.
+    try:
+        from fairness_llm_tpu.pipeline.phase2 import pairwise_evaluation
+
+        pw_settings = settings_cls(
+            temperature=0.7, top_k=0, top_p=1.0, max_tokens=16
+        )
+        # Warm with the SAME seed as the timed run: the seed picks the item
+        # pairs, so a different seed could sample longer prompts that cross
+        # a bucket boundary and put a fresh compile inside the timed window.
+        pairwise_evaluation(backend, items, 200, pw_settings, seed=1)  # compile
+        t0 = time.perf_counter()
+        _, comps = pairwise_evaluation(backend, items, 200, pw_settings, seed=1)
+        wall = time.perf_counter() - t0
+        unparsed = sum(1 for c in comps if not c["parsed"])
+        out["pairwise_200"] = {
+            "num_comparisons": len(comps),
+            "wall_s": round(wall, 3),
+            "comparisons_per_sec": round(len(comps) / wall, 2),
+            # random weights parse poorly; the rate is the honest field the
+            # study reports either way (parse_failures in phase2 results)
+            "parse_failure_rate": round(unparsed / max(len(comps), 1), 3),
+        }
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"pairwise-200 skipped: {type(e).__name__}: {e}", file=sys.stderr)
     del eng
 
     # 150-item listwise (S≈7k): the corpus size DENSE attention provably
@@ -506,6 +607,10 @@ def _run() -> None:
     big_rate_int8 = None
     big8_stats = None
     big_rate_int8_kernel = None
+    grouped_rate_int8 = None
+    grouped_shapes = None
+    big_rate_int8w = None
+    big8w_stats = None
     try:
         big = list(prompts) * 4
         engine.generate(big, settings, seed=0)
@@ -531,7 +636,68 @@ def _run() -> None:
             jax.block_until_ready(out8.tokens)
             big_rate_int8 = len(big8) / (time.perf_counter() - t0)
             big8_stats = out8.stats
+
+            # LEVER A (VERDICT r4 weak item 1): remainder-length grouping.
+            # The single-bucket batch pads every row's remainder to the
+            # longest profile's bucket; decoding short-remainder and
+            # long-remainder halves as two programs tightens each group's
+            # prompt_len (32-multiple buckets) at the cost of streaming the
+            # weight tree twice. Both halves pass the SAME sweep-wide
+            # explicit prefix so attention layout matches the baseline.
+            try:
+                from fairness_llm_tpu.pipeline.backends import (
+                    EngineBackend,
+                    shared_prefix_ids,
+                )
+
+                pref = shared_prefix_ids(EngineBackend(eng8), big8)
+                if pref is not None:
+                    rows = [eng8.tokenizer.encode(p) for p in big8]
+                    order = sorted(range(len(big8)), key=lambda i: len(rows[i]))
+                    half = (len(big8) // 2) // 8 * 8
+                    gs = [
+                        [big8[i] for i in order[:half]],
+                        [big8[i] for i in order[half:]],
+                    ]
+                    for g in gs:  # compile both shapes
+                        eng8.generate(g, settings, seed=0, prefix_ids=pref)
+                    t0 = time.perf_counter()
+                    shapes = []
+                    for g in gs:
+                        og = eng8.generate(g, settings, seed=99, prefix_ids=pref)
+                        shapes.append(og.stats)
+                    jax.block_until_ready(og.tokens)
+                    grouped_rate_int8 = len(big8) / (time.perf_counter() - t0)
+                    grouped_shapes = shapes
+            except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+                print(f"grouped-sweep skipped: {type(e).__name__}", file=sys.stderr)
             del eng8
+
+            # LEVER B (same verdict item): int8 WEIGHTS x int8 KV at the
+            # 360-row sweet spot — best_sustained has always streamed
+            # f32/bf16 weights; the dequant-in-tile tree cuts the per-step
+            # param stream (gpt2's tied embedding stays float, so the win
+            # is bounded by the non-embed fraction).
+            if config.weight_quant == "none":
+                # Local try: a failure here (batch-360 is OOM-prone on big
+                # models) must not abort the int8-KV kernel A/B below, whose
+                # per-round trend predates this lever.
+                try:
+                    cfg8w = dataclasses.replace(cfg8, weight_quant="int8")
+                    eng8w = DecodeEngine(cfg8w, seed=0)
+                    try:
+                        eng8w.generate(big8, settings, seed=0)
+                        t0 = time.perf_counter()
+                        out8w = eng8w.generate(big8, settings, seed=99)
+                        jax.block_until_ready(out8w.tokens)
+                        big_rate_int8w = len(big8) / (time.perf_counter() - t0)
+                        big8w_stats = out8w.stats
+                    finally:
+                        del eng8w
+                except Exception as e:  # noqa: BLE001 — auxiliary measurement
+                    print(
+                        f"int8w-sweep skipped: {type(e).__name__}", file=sys.stderr
+                    )
 
             # Fused int8-KV decode-attention kernel (dequant-in-tile,
             # ops/decode_attention.py round 4) A/B at the KV-bound operating
@@ -594,6 +760,20 @@ def _run() -> None:
     flash_proof = flash_memory_proof()
     int8_70b = int8_70b_fit()
 
+    # Big-model live sections (each owns most of HBM; they run only after
+    # every other engine is freed, serially). Fail-soft: a tunnel drop loses
+    # the section, not the round's record.
+    shard70b = None
+    try:
+        shard70b = llama70b_shard_live()
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"70B shard live skipped: {type(e).__name__}: {e}", file=sys.stderr)
+    live8b = None
+    try:
+        live8b = llama3_8b_live(achievable_gbps)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"8B live skipped: {type(e).__name__}: {e}", file=sys.stderr)
+
     # Roofline accounting per operating point: the headline (45 profiles,
     # the framework's WORST sustained number) plus each large-sweep point,
     # so "is decode efficient at scale" is answered where it's best.
@@ -625,15 +805,58 @@ def _run() -> None:
             large_sweep_int8["kernel_speedup"] = round(
                 big_rate_int8_kernel / big_rate_int8, 3
             )
+        # Lever A record: remainder-length grouping A/B at the same rows.
+        if grouped_rate_int8:
+            large_sweep_int8["grouped_profiles_per_sec"] = round(
+                grouped_rate_int8, 2
+            )
+            large_sweep_int8["grouped_speedup"] = round(
+                grouped_rate_int8 / big_rate_int8, 3
+            )
+            large_sweep_int8["grouped_shapes"] = grouped_shapes
+    # Lever B record: int8 weights UNDER the int8-KV operating point.
+    cfg_int8w = _dc.replace(config, kv_cache_quant=True, weight_quant="int8")
+    large_sweep_int8w = roofline(
+        cfg_int8w, big8w_stats, big_rate_int8w, len(prompts) * 8
+    )
+    if large_sweep_int8w is not None and big_rate_int8:
+        large_sweep_int8w["vs_float_weights"] = round(
+            big_rate_int8w / big_rate_int8, 3
+        )
     candidates = [
         ("base", roofline(config, sweep_stats, profiles_per_sec, len(prompts))),
         ("large_sweep", large_sweep),
         ("large_sweep_int8kv", large_sweep_int8),
+        ("large_sweep_int8w_int8kv", large_sweep_int8w),
     ]
     if big_rate_int8_kernel and big8_stats:
         candidates.append(
             ("large_sweep_int8kv_kernel",
              roofline(cfg_int8, big8_stats, big_rate_int8_kernel, len(prompts) * 8))
+        )
+    if grouped_rate_int8 and grouped_shapes:
+        # The grouped point streams DIFFERENT bytes than the single-program
+        # batch (weight tree twice, tighter per-half KV), so its bandwidth
+        # fields are computed from the halves' own shapes — best_sustained
+        # must never carry roofline numbers for bytes it didn't stream.
+        g_bytes = sum(
+            decode_step_bytes(cfg_int8, s) * MAX_NEW_TOKENS for s in grouped_shapes
+        )
+        g_wall = len(prompts) * 8 / grouped_rate_int8
+        g_gbps = g_bytes / g_wall / 1e9
+        candidates.append(
+            ("large_sweep_int8kv_grouped", {
+                "profiles_per_sec": round(grouped_rate_int8, 2),
+                "decode_shape": grouped_shapes,
+                "decode_bytes_per_step_mb": [
+                    round(decode_step_bytes(cfg_int8, s) / 1e6, 1)
+                    for s in grouped_shapes
+                ],
+                "achieved_hbm_gbps": round(g_gbps, 1),
+                "achieved_over_achievable": (
+                    round(g_gbps / achievable_gbps, 3) if achievable_gbps else None
+                ),
+            })
         )
     best_label, best_point = max(
         (c for c in candidates if c[1]),
@@ -698,12 +921,16 @@ def _run() -> None:
             ),
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
+            "large_sweep_int8w_int8kv": large_sweep_int8w,
             "best_sustained": (
                 {"operating_point": best_label, **best_point} if best_point else None
             ),
             "phase2_listwise": phase2_listwise,
             "flash_memory_proof": flash_proof,
             "int8_70b_fit": int8_70b,
+            "llama70b_shard": shard70b,
+            "llama3_8b_live": live8b,
+            "phase2_7b": phase2_7b_committed(),
             "reference_api_baseline": (
                 "reference README: ~15 min for the 45-profile sweep via API "
                 "(what vs_reference_api_sweep is measured against)"

@@ -273,6 +273,13 @@ def run_phase1(
             # provenance of the DP/EO reduction: "dp-psum" = on-device over the
             # mesh the sweep decoded on; "host" = single-device numpy+jit path
             "metric_reduction": "dp-psum" if use_device_reduction else "host",
+            # the served weight mode, read from the ENGINE (the serving
+            # truth), so an int8-weight study record witnesses the quantized
+            # path in its own metadata; None for non-engine backends
+            "weight_quant": getattr(
+                getattr(getattr(backend, "engine", None), "config", None),
+                "weight_quant", None,
+            ),
             # corpus identity — committed records pin THIS (regression tests
             # compare only when provenance matches) instead of requiring the
             # ML-1M data to be absent
