@@ -35,7 +35,14 @@ V5E_HBM_GB = 15.75  # the v5e compiler's own HBM figure (memory_stats is
 # unavailable over the tunneled-device backend)
 
 
-def run(max_new: int = 128, include_probe: bool = True) -> dict:
+def run(max_new: int = 128, include_probe: bool = True,
+        kv_quant: bool = False, skip_listwise: bool = False) -> dict:
+    """``kv_quant=True`` serves the sweep with the int8 KV cache on top of
+    the int8 weights — the KV/prefix reads are ~2.3 GB of the 10 GB step,
+    so halving them probes whether the 8B operating point is KV-bound the
+    way the gpt2 batch-360 curve is."""
+    import dataclasses
+
     import jax
 
     from bench import (
@@ -54,6 +61,8 @@ def run(max_new: int = 128, include_probe: bool = True) -> dict:
             "decode lengths max_new and max(8, max_new//4)"
         )
     config = get_model_config("llama3-8b-int8")
+    if kv_quant:
+        config = dataclasses.replace(config, kv_cache_quant=True)
     t0 = time.time()
     eng = DecodeEngine(config, seed=0)
     jax.block_until_ready(jax.tree.leaves(eng.params)[0])
@@ -86,15 +95,16 @@ def run(max_new: int = 128, include_probe: bool = True) -> dict:
 
     # HBM occupancy at the sweep operating point: exact param-tree bytes +
     # the analytic KV/prefix accounting the roofline model uses.
-    per_slot = (
-        config.num_kv_heads * config.head_dim * 2 * 2 * config.num_layers
-    )  # bf16 cache
+    per_head_slot = (config.head_dim + 4) if config.kv_cache_quant else (
+        config.head_dim * 2
+    )
+    per_slot = config.num_kv_heads * per_head_slot * 2 * config.num_layers
     kv_bytes = out.stats["batch"] * out.stats["cache_slots"] * per_slot
     prefix_bytes = out.stats["prefix_len"] * per_slot
     used_gb = (param_bytes + kv_bytes + prefix_bytes) / 1e9
 
     result = {
-        "model": config.name,
+        "model": config.name + ("+int8kv" if kv_quant else ""),
         "baseline_config": "BASELINE.json configs[1]: Llama-3-8B, TP=1, one chip",
         "init_s": round(init_s, 1),
         "param_tree_gb": round(param_bytes / 1e9, 2),
@@ -122,6 +132,10 @@ def run(max_new: int = 128, include_probe: bool = True) -> dict:
             "hbm_headroom_gb": round(V5E_HBM_GB - used_gb, 2),
         },
     }
+
+    if skip_listwise:
+        del eng
+        return result
 
     # Phase-2 listwise on the SAME live engine (flash prefill; head_dim 128).
     # share_prefix=False so the flash kernel actually runs (the auto-detected
