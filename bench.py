@@ -77,6 +77,75 @@ def decode_step_bytes(config, stats) -> int:
     return params + kv + prefix
 
 
+def measure_speculative(engine, prompts, settings_cls) -> dict | None:
+    """Phase-1 sweep decoded GREEDILY with prompt-lookup speculation off vs on.
+
+    Speculation is exact only for greedy decode, so this entry runs the same
+    45-profile sweep at temperature 0 (the sweep's own 0.7-sampled headline
+    can't use it). The sweep decodes in the STUDY's own chunking
+    (``config.decode_batch_size``, the shape ``pipeline.phase1.decode_sweep``
+    actually runs) — which on the CPU harness is also the decode-bound
+    operating point where a verify step costs about a plain step (at
+    whole-sweep batch a CPU is compute-bound and the k+1-wide forward
+    multiplies FLOPs; on TPU decode is HBM-bound at every batch). Reports
+    tokens/sec both ways plus measured acceptance and verify-step
+    compression — the numbers the ISSUE-1 target (>= 1.2x) is judged on.
+    Measured on the repo's CPU harness: 2.0x (28.4 -> 58.0 tok/s) at 46%
+    acceptance, 28 verify steps for 128-token rows. Reuses the headline
+    engine (same params; greedy programs compile alongside the sampled ones).
+    """
+    import numpy as np
+
+    from fairness_llm_tpu.config import SpeculationConfig, default_config
+    from fairness_llm_tpu.utils.profiling import SpeculationStats
+
+    settings = settings_cls(temperature=0.0, top_k=0, top_p=1.0,
+                            max_tokens=MAX_NEW_TOKENS)
+    spec = SpeculationConfig(enabled=True)
+    pad_id = engine.tokenizer.pad_id
+    chunk = max(default_config().decode_batch_size, 1)
+    chunks = [prompts[i : i + chunk] for i in range(0, len(prompts), chunk)]
+    out: dict = {
+        "profiles": len(prompts),
+        "decode_batch_size": chunk,
+        "max_new_tokens": MAX_NEW_TOKENS,
+        "draft_len": spec.draft_len,
+        "ngram_max": spec.ngram_max,
+    }
+    for label, sp in (("off", None), ("on", spec)):
+        # Compile outside the timed window: one warmup per DISTINCT chunk
+        # size (same-size chunks pad to the same bucket and share a program).
+        warmed = set()
+        for c in chunks:
+            if len(c) not in warmed:
+                warmed.add(len(c))
+                engine.generate(c, settings, seed=0, speculation=sp)
+        totals = SpeculationStats()
+        ntok = 0
+        t0 = time.perf_counter()
+        for c in chunks:
+            o = engine.generate(c, settings, seed=1, speculation=sp)
+            jax.block_until_ready(o.tokens)
+            # Greedy real models can stop at EOS early; count tokens actually
+            # decoded rather than assuming the cap.
+            ntok += int(np.sum(o.tokens != pad_id))
+            st = (o.stats or {}).get("speculation")
+            if st:
+                totals = totals.merge(SpeculationStats.from_dict(st))
+        wall = time.perf_counter() - t0
+        out[label] = {
+            "wall_s": round(wall, 3),
+            "decoded_tokens": ntok,
+            "tokens_per_sec": round(ntok / wall, 1),
+            "speculation": totals.as_dict() if totals.verify_steps else None,
+        }
+    out["speedup"] = round(out["off"]["wall_s"] / out["on"]["wall_s"], 3)
+    on_spec = out["on"]["speculation"] or {}
+    out["acceptance_rate"] = on_spec.get("acceptance_rate")
+    out["verify_steps"] = on_spec.get("verify_steps")
+    return out
+
+
 def measure_achievable_gbps() -> float | None:
     """This chip's ACHIEVABLE streaming bandwidth, measured in-run.
 
@@ -595,6 +664,15 @@ def _run() -> None:
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"decode-kernel A/B skipped: {type(e).__name__}", file=sys.stderr)
 
+    # Speculative decoding A/B on the same sweep, greedy (ISSUE 1): off vs on
+    # tokens/sec plus measured acceptance. Runs while the headline engine is
+    # alive (it reuses the params; only two more compiled programs).
+    speculative = None
+    try:
+        speculative = measure_speculative(engine, prompts, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"speculative A/B skipped: {type(e).__name__}: {e}", file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -919,6 +997,7 @@ def _run() -> None:
             "large_sweep_int8kv_profiles_per_sec": (
                 round(big_rate_int8, 3) if big_rate_int8 else None
             ),
+            "speculative": speculative,
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
             "large_sweep_int8w_int8kv": large_sweep_int8w,

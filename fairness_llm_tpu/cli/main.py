@@ -111,6 +111,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=None,
                    help="global decode-length cap: clamps every model's "
                         "max_tokens (bounds per-sweep decode cost)")
+    p.add_argument("--speculate", action="store_true",
+                   help="prompt-lookup speculative decoding for engine "
+                        "backends (greedy decode only — sampled settings "
+                        "silently use the plain path; output is identical "
+                        "either way, see runtime/speculative.py)")
+    p.add_argument("--draft-len", type=int, default=None,
+                   help="with --speculate: drafted tokens verified per step")
+    p.add_argument("--ngram-max", type=int, default=None,
+                   help="with --speculate: longest lookup n-gram tried first")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--weight-quant", default=None, choices=("none", "int8"),
@@ -153,6 +162,21 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["max_new_tokens"] = args.max_new_tokens
     if args.quick:
         updates["profiles_per_combo"] = 1
+    if args.speculate or args.draft_len is not None or args.ngram_max is not None:
+        from fairness_llm_tpu.config import SpeculationConfig
+
+        if not args.speculate:
+            raise SystemExit("--draft-len/--ngram-max require --speculate")
+        spec_kwargs = {"enabled": True}
+        if args.draft_len is not None:
+            if args.draft_len < 1:
+                raise SystemExit("--draft-len must be >= 1")
+            spec_kwargs["draft_len"] = args.draft_len
+        if args.ngram_max is not None:
+            if args.ngram_max < 1:
+                raise SystemExit("--ngram-max must be >= 1")
+            spec_kwargs["ngram_max"] = args.ngram_max
+        updates["speculation"] = SpeculationConfig(**spec_kwargs)
     if updates:
         config = dataclasses.replace(config, **updates)
     return config

@@ -30,6 +30,26 @@ class ModelSettings:
 
 
 @dataclasses.dataclass(frozen=True)
+class SpeculationConfig:
+    """Prompt-lookup speculative decoding knobs (``runtime/speculative.py``).
+
+    The phase-1/3 sweeps emit ranked lists of titles copied verbatim from the
+    candidate list already in the prompt — the ideal regime for draft-free
+    n-gram speculation: draft ``draft_len`` tokens by matching the last
+    ``ngram_max`` generated tokens against the prompt + generated suffix, then
+    verify all of them in ONE forward pass (decode is memory-bound, so the
+    extra verify positions are nearly free). Greedy-only: with temperature>0
+    the engine silently uses the plain sampled decode path (see
+    ``runtime/sampling.py``). Frozen/hashable so it can sit inside the
+    engine's compile keys — toggling it can never reuse a stale program.
+    """
+
+    enabled: bool = False
+    ngram_max: int = 3  # longest suffix n-gram tried first (falls back to 1)
+    draft_len: int = 8  # drafted tokens verified per step (k; step width k+1)
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes follow the scaling-book convention:
 
@@ -111,6 +131,12 @@ class Config:
     weight_quant: Optional[str] = None
     checkpoint_every: int = 20  # profiles between sweep checkpoints (reference: 20)
     profile_trace_dir: Optional[str] = None  # jax.profiler trace output
+    # Prompt-lookup speculative decoding for greedy sweeps (off by default:
+    # the stock study settings sample at temperature 0.7, where speculation
+    # cannot apply — see SpeculationConfig).
+    speculation: SpeculationConfig = dataclasses.field(
+        default_factory=SpeculationConfig
+    )
 
     def settings_for(self, model_name: str) -> ModelSettings:
         for name, settings in self.model_settings:

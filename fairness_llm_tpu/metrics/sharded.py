@@ -40,18 +40,17 @@ def sharded_group_counts(
     into [G, V] and ``psum`` completes the reduction over ICI. N must be a
     multiple of the dp axis (callers zero-pad; zero rows contribute nothing).
     """
-    from jax import shard_map
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
 
     def local_reduce(counts, gids):
         local = jax.ops.segment_sum(counts, gids, num_segments=num_groups)  # [G, V]
         return jax.lax.psum(local, "dp")
 
-    fn = shard_map(
+    fn = compat_shard_map(
         local_reduce,
-        mesh=mesh,
+        mesh,
         in_specs=(P("dp", None), P("dp")),
         out_specs=P(),
-        check_vma=False,
     )
     counts_sharded = jax.device_put(per_profile_counts, NamedSharding(mesh, P("dp", None)))
     gids_sharded = jax.device_put(group_ids, NamedSharding(mesh, P("dp")))

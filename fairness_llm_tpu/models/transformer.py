@@ -60,6 +60,29 @@ def _dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
+def _cache_write(buf: jnp.ndarray, upd: jnp.ndarray, index, write_offsets):
+    """Write ``upd`` into ``buf`` along the slot axis (axis 1 of [B, L, ...]).
+
+    ``write_offsets=None``: one uniform ``dynamic_update_slice`` at ``index``
+    (the left-padded batch invariant — every row writes the same slots).
+    ``write_offsets=[B]``: per-row slot offsets (speculative verify steps,
+    where rows advance by their own accepted counts) — a vmapped DUS, which
+    XLA lowers to a batched scatter over the small [S, ...] update window.
+    """
+    zero = jnp.zeros((), jnp.int32)
+    if write_offsets is None:
+        return jax.lax.dynamic_update_slice(
+            buf, upd, (zero, index) + (zero,) * (buf.ndim - 2)
+        )
+
+    def one(b, u, off):
+        return jax.lax.dynamic_update_slice(
+            b, u, (off,) + (zero,) * (b.ndim - 1)
+        )
+
+    return jax.vmap(one)(buf, upd, write_offsets)
+
+
 @flax.struct.dataclass
 class KVCache:
     """Decode state shared across layers.
@@ -339,10 +362,11 @@ class Attention(nn.Module):
             b_ax = None
         from jax.sharding import PartitionSpec as P
 
-        return jax.shard_map(
+        from fairness_llm_tpu.parallel.sharding import compat_shard_map
+
+        return compat_shard_map(
             call,
-            mesh=mesh,
-            axis_names=frozenset(mesh.axis_names),
+            mesh,
             in_specs=(
                 P(b_ax, qh_ax, None, None),
                 P(b_ax, kv_ax, None, None),
@@ -350,21 +374,23 @@ class Attention(nn.Module):
                 P(b_ax),
             ),
             out_specs=P(b_ax, qh_ax, None, None),
-            check_vma=False,
         )(q, k, v, lengths)
 
     def _decode_kernel_ok(
         self, seq_len: int, cache_layer, batch: int, cache_len: int,
-        shared_len: int = 0,
+        shared_len: int = 0, multi_q: bool = False,
     ) -> bool:
         """Static gate for the fused decode-attention kernel: TPU, a cached
-        SINGLE-token step (key_valid alone encodes causality there), XLA-path
-        semantics (no ring), no sliding window (mask not implemented in the
-        kernel), and tile-compatible shapes. An int8 cache takes the
-        dequant-in-tile kernel mode (the kernel streams int8 + scales, so
-        its VMEM envelope is ~4x the f32 accounting)."""
+        SINGLE-token step (key_valid alone encodes causality there) or a
+        short multi-token speculative verify step (``multi_q`` — per-row
+        write offsets supply the causal window), XLA-path semantics (no
+        ring), no sliding window (mask not implemented in the kernel), and
+        tile-compatible shapes. An int8 cache takes the dequant-in-tile
+        kernel mode (the kernel streams int8 + scales, so its VMEM envelope
+        is ~4x the f32 accounting)."""
         cfg = self.config
-        if not (cfg.use_decode_attention_kernel and seq_len == 1 and cache_layer is not None):
+        q_ok = seq_len == 1 or (multi_q and seq_len <= 16)
+        if not (cfg.use_decode_attention_kernel and q_ok and cache_layer is not None):
             return False
         if cfg.sliding_window is not None:
             return False
@@ -382,7 +408,8 @@ class Attention(nn.Module):
         else:
             itemsize = 2 if cfg.dtype == "bfloat16" else 4
         return decode_attn_supported(
-            batch, cache_len, cfg.head_dim, shared_len, kv_itemsize=itemsize
+            batch, cache_len, cfg.head_dim, shared_len, kv_itemsize=itemsize,
+            q_len=seq_len,
         )
 
     @nn.compact
@@ -396,12 +423,21 @@ class Attention(nn.Module):
         key_positions: jnp.ndarray,  # [B, K]
         left_padded: bool = False,
         shared_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        write_offsets: Optional[jnp.ndarray] = None,
     ):
         # ``shared_kv``: (k, v) each [Pc, Hkv, D] — a prompt prefix COMMON to
         # every batch row, computed once and read once per step instead of
         # B times (prefix caching; decode is KV-read-bound). Shared keys sit
         # at global positions 0..Pc-1, strictly before every query, so they
         # are always causally visible; per-row positions are offset by Pc.
+        #
+        # ``write_offsets``: [B] int32 per-row cache-slot offsets for the new
+        # tokens (speculative verify steps — rows advance at their own
+        # accepted rates, so the uniform ``cache_index`` cannot serve).
+        # When given, it replaces ``cache_index`` for BOTH the cache writes
+        # and the causal rule: query i of row b may see own-cache slot j iff
+        # j <= write_offsets[b] + i (the "small causal window" against the
+        # already-valid cache).
         cfg = self.config
         dtype = _dtype_of(cfg)
         # qwen2 carries biases on q/k/v only (o_proj and MLP stay bias-free).
@@ -434,20 +470,19 @@ class Attention(nn.Module):
 
         # Shared cache write (prefill records the prompt for later decode steps).
         if cache_layer is not None:
-            zero = jnp.zeros((), jnp.int32)
             if cfg.kv_cache_quant:
                 qk, k_sc = _quantize_kv(k)
                 qv, v_sc = _quantize_kv(v)
-                ck = jax.lax.dynamic_update_slice(cache_layer.k, qk, (zero, cache_index, zero, zero))
-                cv = jax.lax.dynamic_update_slice(cache_layer.v, qv, (zero, cache_index, zero, zero))
-                cks = jax.lax.dynamic_update_slice(cache_layer.k_scale, k_sc, (zero, cache_index, zero))
-                cvs = jax.lax.dynamic_update_slice(cache_layer.v_scale, v_sc, (zero, cache_index, zero))
+                ck = _cache_write(cache_layer.k, qk, cache_index, write_offsets)
+                cv = _cache_write(cache_layer.v, qv, cache_index, write_offsets)
+                cks = _cache_write(cache_layer.k_scale, k_sc, cache_index, write_offsets)
+                cvs = _cache_write(cache_layer.v_scale, v_sc, cache_index, write_offsets)
                 new_cache_layer = LayerCache(k=ck, v=cv, k_scale=cks, v_scale=cvs)
                 keys = _dequantize_kv(ck, cks, dtype)
                 values = _dequantize_kv(cv, cvs, dtype)
             else:
-                keys = jax.lax.dynamic_update_slice(cache_layer.k, k.astype(dtype), (zero, cache_index, zero, zero))
-                values = jax.lax.dynamic_update_slice(cache_layer.v, v.astype(dtype), (zero, cache_index, zero, zero))
+                keys = _cache_write(cache_layer.k, k.astype(dtype), cache_index, write_offsets)
+                values = _cache_write(cache_layer.v, v.astype(dtype), cache_index, write_offsets)
                 new_cache_layer = LayerCache(k=keys, v=values)
         else:
             keys, values = k, v
@@ -484,43 +519,58 @@ class Attention(nn.Module):
         elif self._decode_kernel_ok(
             S, cache_layer, keys.shape[0], keys.shape[1],
             0 if shared_kv is None else shared_kv[0].shape[0],
+            multi_q=write_offsets is not None,
         ):
-            # Single-token cached decode: the Pallas fused kernel. key_valid
+            # Cached decode: the Pallas fused kernel. For S == 1, key_valid
             # alone is the mask (slots past the write index are invalid, so
-            # causality is already encoded for S == 1).
+            # causality is already encoded). For a speculative verify step
+            # (S == k+1, write_offsets given) the kernel additionally applies
+            # the small causal window j <= offsets[b] + i over the newly
+            # written slots.
             from fairness_llm_tpu.ops.decode_attention import decode_attention
 
             sh = None if shared_kv is None else (
                 shared_kv[0].astype(dtype), shared_kv[1].astype(dtype)
             )
+            kq = q[:, 0] if S == 1 else q  # [B, H, D] or [B, S, H, D]
             if cfg.kv_cache_quant:
                 # Raw int8 cache + scales straight into the kernel; the
                 # dequantized `keys`/`values` computed above are unused in
                 # this branch and get dead-code-eliminated, so the step
                 # streams HALF the cache bytes of the bf16 path.
                 out = decode_attention(
-                    q[:, 0], new_cache_layer.k, new_cache_layer.v, key_valid,
+                    kq, new_cache_layer.k, new_cache_layer.v, key_valid,
                     shared_kv=sh,
                     k_scale=new_cache_layer.k_scale,
                     v_scale=new_cache_layer.v_scale,
-                )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
+                    q_offsets=write_offsets,
+                ).reshape(B, S, cfg.num_heads, cfg.head_dim)
             else:
                 out = decode_attention(
-                    q[:, 0], keys.astype(dtype), values.astype(dtype), key_valid,
-                    shared_kv=sh,
-                )[:, None, :, :].reshape(B, S, cfg.num_heads, cfg.head_dim)
+                    kq, keys.astype(dtype), values.astype(dtype), key_valid,
+                    shared_kv=sh, q_offsets=write_offsets,
+                ).reshape(B, S, cfg.num_heads, cfg.head_dim)
         else:
             if cache_layer is not None:
                 K = keys.shape[1]
-                # causal: new query i (global slot index+i) sees key slot j iff j <= index+i
                 j_idx = jnp.arange(K)[None, :]
-                q_idx = cache_index + jnp.arange(S)[:, None]
-                causal = j_idx <= q_idx  # [S, K]
+                if write_offsets is None:
+                    # causal: new query i (global slot index+i) sees key slot
+                    # j iff j <= index+i
+                    q_idx = cache_index + jnp.arange(S)[:, None]
+                    causal = (j_idx <= q_idx)[None, :, :]  # [1, S, K]
+                else:
+                    # per-row window: query i of row b wrote slot offsets[b]+i
+                    q_idx = (
+                        write_offsets[:, None, None]
+                        + jnp.arange(S)[None, :, None]
+                    )  # [B, S, 1]
+                    causal = j_idx[None, :, :] <= q_idx  # [B, S, K]
             else:
                 K = S
-                causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+                causal = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, :, :]
 
-            allowed = causal[None, :, :] & key_valid[:, None, :]  # [B, S, K]
+            allowed = causal & key_valid[:, None, :]  # [B, S, K]
             if cfg.sliding_window is not None:
                 delta = positions[:, :, None] - key_positions[:, None, :]
                 allowed = allowed & (delta < cfg.sliding_window)
@@ -614,11 +664,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, cache_layer, cache_index, key_valid, key_positions,
-                 left_padded=False, shared_kv=None):
+                 left_padded=False, shared_kv=None, write_offsets=None):
         attn_out, new_cache = Attention(self.config, name="attn")(
             _norm(self.config, "attn_norm")(x),
             positions, cache_layer, cache_index, key_valid, key_positions,
             left_padded=left_padded, shared_kv=shared_kv,
+            write_offsets=write_offsets,
         )
         x = x + attn_out
         x = x + MLP(self.config, name="mlp")(_norm(self.config, "mlp_norm")(x))
@@ -652,6 +703,7 @@ class Transformer(nn.Module):
         left_padded: bool = False,  # promise: valid tokens occupy trailing slots
         last_only: bool = False,  # return logits for the final position only
         shared_layers: Optional[Tuple] = None,  # per-layer (k, v) [Pc, Hkv, D] prefix KV
+        write_offsets: Optional[jnp.ndarray] = None,  # [B] per-row cache slots
     ) -> Tuple[jnp.ndarray, Optional[KVCache]]:
         cfg = self.config
         dtype = _dtype_of(cfg)
@@ -684,9 +736,8 @@ class Transformer(nn.Module):
                 raise ValueError(
                     f"writing {S} tokens into a cache of max_len {cache.max_len}"
                 )
-            zero = jnp.zeros((), jnp.int32)
-            key_valid = jax.lax.dynamic_update_slice(cache.key_valid, token_valid, (zero, cache.index))
-            key_positions = jax.lax.dynamic_update_slice(cache.key_positions, positions, (zero, cache.index))
+            key_valid = _cache_write(cache.key_valid, token_valid, cache.index, write_offsets)
+            key_positions = _cache_write(cache.key_positions, positions, cache.index, write_offsets)
         else:
             key_valid = token_valid
             key_positions = positions
@@ -699,6 +750,7 @@ class Transformer(nn.Module):
                 layer_cache, cache.index if cache is not None else None,
                 key_valid, key_positions, left_padded=left_padded,
                 shared_kv=shared_layers[i] if shared_layers is not None else None,
+                write_offsets=write_offsets,
             )
             new_layers.append(new_layer)
 

@@ -167,9 +167,10 @@ def _quant_matmul_pallas(x, wq, scale, interpret: bool, out_dtype):
         out_specs=pl.BlockSpec((bm_t, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((bm, n), out_dtype),
         scratch_shapes=[pltpu.VMEM((bm_t, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
+        # CompilerParams was TPUCompilerParams before jax 0.7.
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, wq, scale[None, :])
     return out[:m]
@@ -237,11 +238,11 @@ def quant_matmul_sharded(
             return jax.lax.psum(y, k_axis).astype(out_dtype)
         return quant_matmul(xl, wql, scalel, interpret=interpret, out_dtype=out_dtype)
 
-    return jax.shard_map(
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
+
+    return compat_shard_map(
         local,
-        mesh=mesh,
-        axis_names=frozenset(mesh.axis_names),
+        mesh,
         in_specs=(P(b_axis, k_axis), P(k_axis, n_axis), P(n_axis)),
         out_specs=P(b_axis, n_axis),
-        check_vma=False,
     )(x, wq, scale)

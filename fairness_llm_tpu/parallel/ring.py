@@ -123,17 +123,16 @@ def ring_attention_sharded(
     causal: bool = True,
 ) -> jnp.ndarray:
     """shard_map wrapper: sequence over ``sp``, batch over ``dp``, heads over ``tp``."""
-    from jax import shard_map
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
 
     specs_qkv = P("dp", "sp", "tp", None)
     specs_seq = P("dp", "sp")
 
-    fn = shard_map(
+    fn = compat_shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=causal),
-        mesh=mesh,
+        mesh,
         in_specs=(specs_qkv, specs_qkv, specs_qkv, specs_seq, specs_seq, specs_seq),
         out_specs=specs_qkv,
-        check_vma=False,
     )
     return fn(q, k, v, positions, positions, valid)
 

@@ -47,11 +47,20 @@ class DecodeBackend(Protocol):
 
 
 class EngineBackend:
-    """Real in-framework decode."""
+    """Real in-framework decode.
 
-    def __init__(self, engine, name: Optional[str] = None):
+    ``speculation`` (a ``SpeculationConfig``) turns on prompt-lookup
+    speculative decoding for greedy sweeps; the engine falls back to the
+    plain path for sampled settings, so it is always safe to set. Per-sweep
+    counters accumulate in ``spec_totals`` (a ``SpeculationStats``) so phase
+    drivers can record acceptance in their result metadata.
+    """
+
+    def __init__(self, engine, name: Optional[str] = None, speculation=None):
         self.engine = engine
         self.name = name or engine.config.name
+        self.speculation = speculation
+        self.spec_totals = None  # Optional[SpeculationStats], set lazily
 
     def generate(
         self,
@@ -66,13 +75,24 @@ class EngineBackend:
             # Per-row sampling streams keyed on stable identity, so resumed /
             # re-chunked sweeps decode identical text for the same profile.
             row_seeds = [(_stable_hash(k) ^ seed) & 0xFFFFFFFF for k in keys]
-        return self.engine.generate(
+        out = self.engine.generate(
             prompts, settings, seed=seed, row_seeds=row_seeds,
             prefix_ids=prefix_ids,
             # sweeps pass an explicit sweep-wide prefix; never auto-detect per
             # chunk (composition-dependent — see engine.generate docstring)
             share_prefix=None if prefix_ids is not None else False,
-        ).texts
+            speculation=self.speculation,
+        )
+        sp = (out.stats or {}).get("speculation")
+        if sp is not None:
+            from fairness_llm_tpu.utils.profiling import SpeculationStats
+
+            chunk = SpeculationStats.from_dict(sp)
+            self.spec_totals = (
+                chunk if self.spec_totals is None
+                else self.spec_totals.merge(chunk)
+            )
+        return out.texts
 
 
 def shared_prefix_ids(backend, prompts: Sequence[str]) -> Optional[List[int]]:
@@ -322,4 +342,10 @@ def backend_for(
         seed=config.random_seed,
         assume_sharded=loaded_sharded,
     )
-    return EngineBackend(engine, name=model_name)
+    # Speculation rides on the backend (not the engine default) so sweeps
+    # opted in via Config get it while direct engine users stay explicit.
+    spec = getattr(config, "speculation", None)
+    return EngineBackend(
+        engine, name=model_name,
+        speculation=spec if (spec is not None and spec.enabled) else None,
+    )

@@ -207,6 +207,10 @@ def run_phase1(
     ]
     neutral_prompts = [recommendation_prompt(p, anonymize=True) for p in neutral_profiles]
 
+    if hasattr(backend, "spec_totals"):
+        # Reused/injected backends may carry speculation counters from
+        # earlier runs; this record is THIS sweep's decodes only.
+        backend.spec_totals = None
     done = R.load_latest_checkpoint(config.results_dir, "phase1") if resume else {}
     recs = decode_sweep(
         backend,
@@ -284,6 +288,12 @@ def run_phase1(
             # compare only when provenance matches) instead of requiring the
             # ML-1M data to be absent
             "corpus": data.provenance(),
+            # prompt-lookup speculative decoding counters for the whole sweep
+            # (None when speculation was off / inapplicable / non-engine)
+            "speculation": (
+                backend.spec_totals.as_dict()
+                if getattr(backend, "spec_totals", None) is not None else None
+            ),
         },
         "profiles": [p.to_dict() for p in profiles],
         "recommendations": {

@@ -400,10 +400,20 @@ def run_phase2(
             "phase2: evaluating %s (%s corpus, %d items, %d listwise queries)",
             name, corpus, len(items), num_queries,
         )
+        if hasattr(backend, "spec_totals"):
+            # Reused/injected backends may carry counters from earlier
+            # phases; this record is THIS evaluation's decodes only.
+            backend.spec_totals = None
         model_results[name] = evaluate_model(
             backend, items, num_comparisons, settings,
             seed=config.random_seed, num_queries=num_queries,
         )
+        # Speculation counters accumulated over this model's listwise +
+        # pairwise decodes (None unless an engine backend ran greedily with
+        # speculation enabled) — same observability as phase 1's metadata.
+        spec_totals = getattr(backend, "spec_totals", None)
+        if spec_totals is not None:
+            model_results[name]["speculation"] = spec_totals.as_dict()
 
     comparison = compare_models_and_methods(model_results)
     results = {

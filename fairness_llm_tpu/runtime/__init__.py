@@ -9,6 +9,21 @@ once, then decoded with a single compiled ``lax.scan`` loop on device.
 """
 
 from fairness_llm_tpu.runtime.engine import DecodeEngine, GenerateOutput
-from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
+from fairness_llm_tpu.runtime.sampling import (
+    SamplerSettings,
+    greedy_accept_length,
+    make_sampler,
+    speculation_applicable,
+)
+from fairness_llm_tpu.runtime.speculative import SpeculationConfig, ngram_draft
 
-__all__ = ["DecodeEngine", "GenerateOutput", "SamplerSettings", "make_sampler"]
+__all__ = [
+    "DecodeEngine",
+    "GenerateOutput",
+    "SamplerSettings",
+    "SpeculationConfig",
+    "greedy_accept_length",
+    "make_sampler",
+    "ngram_draft",
+    "speculation_applicable",
+]

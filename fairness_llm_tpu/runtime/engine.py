@@ -11,6 +11,13 @@ limiting) with ONE device program per batch:
    (early-EOS rows emit pads and their KV writes are masked invalid)
 4. detokenize host-side
 
+Greedy decode can instead take the SPECULATIVE loop (``_spec_decode_fn``):
+each iteration drafts k tokens per row by prompt lookup
+(``runtime/speculative.py``) and verifies all k+1 positions in one forward
+pass with per-row cache write offsets — token-for-token identical output,
+1..k+1 tokens per weight-tree stream instead of exactly one. See
+docs/SPECULATIVE.md.
+
 Sharding: when a mesh is provided, params are placed with the
 ``parallel/sharding.py`` NamedShardings and the token batch is dp-sharded;
 flax logical-axis rules + XLA GSPMD insert the TP collectives. The same
@@ -33,12 +40,19 @@ import jax.numpy as jnp
 import numpy as np
 import flax.linen as nn
 
-from fairness_llm_tpu.config import MeshConfig, ModelSettings
+from fairness_llm_tpu.config import MeshConfig, ModelSettings, SpeculationConfig
 from fairness_llm_tpu.models.configs import ModelConfig
 from fairness_llm_tpu.models.tokenizer import tokenizer_for
 from fairness_llm_tpu.models.transformer import Transformer, init_cache
 from fairness_llm_tpu.parallel import sharding as shd
-from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
+from fairness_llm_tpu.runtime.sampling import (
+    SamplerSettings,
+    greedy_accept_length,
+    make_sampler,
+    speculation_applicable,
+)
+from fairness_llm_tpu.runtime.speculative import ngram_draft
+from fairness_llm_tpu.utils.profiling import SpeculationStats
 
 logger = logging.getLogger(__name__)
 
@@ -49,8 +63,9 @@ class GenerateOutput:
     tokens: np.ndarray  # [B, max_new] int32 (pad-filled after EOS)
     steps: int  # decode-step CAP (max_new_tokens); actual trip count is
     # dynamic — the while_loop exits once every real row hits EOS
-    stats: Optional[Dict[str, int]] = None  # decode-shape diagnostics
-    # (batch, prompt_len, prefix_len, cache_slots) for byte accounting
+    stats: Optional[Dict[str, Any]] = None  # decode-shape diagnostics
+    # (batch, prompt_len, prefix_len, cache_slots) for byte accounting,
+    # plus a "speculation" sub-dict (SpeculationStats) when spec decode ran
 
 
 def _bucket_len(n: int, multiple: int = 64) -> int:
@@ -103,12 +118,15 @@ class DecodeEngine:
         seed: int = 0,
         assume_sharded: bool = False,
         param_dtype: Optional[str] = None,
+        speculation: Optional[SpeculationConfig] = None,
     ):
         """``assume_sharded=True`` skips re-placing params onto the mesh —
         for callers (weights loader) that already device_put each tensor onto
         its NamedSharding at load time. ``param_dtype`` ("float32"/"bfloat16")
-        overrides the size-based storage policy."""
+        overrides the size-based storage policy. ``speculation`` sets the
+        engine-wide default for ``generate`` (per-call arg overrides)."""
         self.config = model_config
+        self.speculation = speculation
         self.tokenizer = tokenizer or tokenizer_for(model_config, tokenizer_path)
         self.mesh = mesh
         if mesh is None and mesh_config is not None and mesh_config.num_devices > 1:
@@ -234,7 +252,13 @@ class DecodeEngine:
 
     def _decode_fn(self, batch: int, prompt_len: int, max_new: int,
                    sampler_settings: SamplerSettings, prefix_len: int = 0):
-        key = (batch, prompt_len, max_new, sampler_settings, prefix_len)
+        # The leading "decode" tag IS the speculation slot of the compile
+        # key: speculative programs live under disjoint ("spec_decode", ...,
+        # ngram_max, draft_len) keys (and their shapes/returns differ), so
+        # toggling speculation can NEVER reuse a stale compiled step for the
+        # other mode (pinned by test_spec_compile_keys_disjoint).
+        key = ("decode", batch, prompt_len, max_new, sampler_settings,
+               prefix_len)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -303,6 +327,170 @@ class DecodeEngine:
         self._compiled[key] = fn
         return fn
 
+    def _spec_decode_fn(self, batch: int, prompt_len: int, max_new: int,
+                        prefix_len: int, spec: SpeculationConfig):
+        """Compiled speculative decode: greedy draft-and-verify.
+
+        One while_loop iteration = ONE multi-token verify forward over
+        ``k+1 = spec.draft_len+1`` positions per row (the greedy next token
+        plus k prompt-lookup drafts), accepting the longest prefix matching
+        greedy argmax — so each iteration emits 1..k+1 tokens per row while
+        streaming params/KV once, vs once PER TOKEN on the plain path.
+        Token-for-token identical to the plain greedy program by
+        construction (parity pinned in tests/test_speculative.py).
+
+        Rows advance at their own acceptance rates, so cache writes use
+        per-row ``write_offsets`` (slot = prompt_len + tokens emitted) and
+        rejected slots are re-invalidated after each step; the next step's
+        window always overwrites them. The cache carries ``draft_len`` spare
+        slots so the last verify window of a nearly-finished row still fits.
+        """
+        k = spec.draft_len
+        key = ("spec_decode", batch, prompt_len, max_new, prefix_len,
+               spec.ngram_max, k)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+
+        cfg = self.config
+        model = self.model
+        pad_id = self.tokenizer.pad_id
+        eos_id = self.tokenizer.eos_id
+        S = k + 1
+        cache_len = prompt_len + max_new + k
+        gen_len = max_new + k  # emit buffer widened so a verify window never
+        # needs clamped writes; sliced back to max_new on return
+
+        def run(params, tokens, valid, row_live, shared_layers, prefix_toks):
+            positions = prefix_len + jnp.maximum(
+                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
+            )
+            cache = init_cache(cfg, batch, cache_len)
+            logits, cache = model.apply(
+                {"params": params}, tokens, positions, valid, cache,
+                left_padded=True, last_only=True, shared_layers=shared_layers,
+            )
+            last_logits = logits[:, -1, :]
+
+            # Lookup context: [shared prefix | left-padded remainder | gen].
+            # The prefix is identical across rows; pad gaps between segments
+            # are masked out of n-gram matching by ctx_valid.
+            pref_tile = jnp.broadcast_to(
+                prefix_toks[None, :], (batch, prefix_len)
+            )
+            ctx_prompt = jnp.concatenate([pref_tile, tokens], axis=1)
+            ctx_prompt_valid = jnp.concatenate(
+                [jnp.ones((batch, prefix_len), bool), valid], axis=1
+            )
+            gen_start = prefix_len + prompt_len
+            gpos = jnp.arange(gen_len, dtype=jnp.int32)[None, :]
+            step_iota = jnp.arange(S, dtype=jnp.int32)
+
+            gen0 = jnp.full((batch, gen_len), pad_id, jnp.int32)
+            out_len0 = jnp.zeros((batch,), jnp.int32)
+            done0 = ~row_live
+            counters0 = jnp.zeros((3,), jnp.int32)  # drafted, accepted, steps
+
+            def cond(carry):
+                step_idx, _, _, done, _, _, _ = carry
+                return (step_idx < max_new) & ~jnp.all(done)
+
+            def body(carry):
+                step_idx, cache, prev_logits, done, gen, out_len, counters = carry
+                live = ~done
+                # The step's guaranteed token: greedy argmax of the carried
+                # logits (identical to the plain loop's sample at temp 0).
+                t0 = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)
+                t0 = jnp.where(live, t0, pad_id)
+                # Drafts via n-gram lookup over history INCLUDING t0.
+                gen_t0 = jnp.where(
+                    (gpos == out_len[:, None]) & live[:, None],
+                    t0[:, None], gen,
+                )
+                ctx = jnp.concatenate([ctx_prompt, gen_t0], axis=1)
+                ctx_valid = jnp.concatenate(
+                    [ctx_prompt_valid, gpos <= out_len[:, None]], axis=1
+                )
+                hist_end = gen_start + out_len + 1
+                drafts = ngram_draft(
+                    ctx, ctx_valid, hist_end, k, spec.ngram_max, pad_id
+                )
+                inp = jnp.concatenate([t0[:, None], drafts], axis=1)  # [B, S]
+
+                # Verify all S positions in one forward; per-row write slots.
+                off = jnp.minimum(prompt_len + out_len, cache_len - S)
+                pos = prefix_len + cache.lengths[:, None] + step_iota[None, :]
+                tv = jnp.broadcast_to(live[:, None], (batch, S))
+                logits, nc = model.apply(
+                    {"params": params}, inp, pos, tv, cache,
+                    shared_layers=shared_layers, write_offsets=off,
+                )
+                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+                # g[:, i] is the model's token AFTER input position i, so
+                # g[:, :k] checks drafts (= inp[:, 1:]).
+                a = greedy_accept_length(drafts, g[:, :k])  # [B] in [0, k]
+
+                # Emitted count e: accepted prefix, truncated at the first
+                # EOS (inclusive — plain decode records EOS then stops) and
+                # at the max_new cap; 0 for done rows.
+                eos_first = jnp.min(
+                    jnp.where(inp == eos_id, step_iota[None, :], S), axis=1
+                )
+                e = jnp.minimum(a + 1, eos_first + 1)
+                e = jnp.minimum(e, max_new - out_len)
+                e = jnp.where(live, e, 0)
+
+                # Scatter the emitted window into the output buffer.
+                widx = gpos - out_len[:, None]  # [B, gen_len]
+                wtok = jnp.take_along_axis(
+                    inp, jnp.clip(widx, 0, S - 1), axis=1
+                )
+                gen = jnp.where((widx >= 0) & (widx < e[:, None]), wtok, gen)
+
+                # Carry logits after the LAST emitted token (the next step's
+                # greedy distribution — this is what makes acceptance exact).
+                pick = jnp.clip(e - 1, 0, S - 1)
+                nl = jnp.take_along_axis(
+                    logits,
+                    jnp.broadcast_to(
+                        pick[:, None, None], (batch, 1, logits.shape[-1])
+                    ),
+                    axis=1,
+                )[:, 0]
+                prev_logits = jnp.where(live[:, None], nl, prev_logits)
+
+                # Cache fixups: invalidate rejected window slots (the next
+                # window starts at off+e and always covers them) and advance
+                # lengths by the ACCEPTED count, not the window width.
+                slot = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+                wpos = slot - off[:, None]
+                in_win = (wpos >= 0) & (wpos < S)
+                fixed_valid = nc.key_valid & ~(in_win & (wpos >= e[:, None]))
+                nc = nc.replace(
+                    key_valid=fixed_valid, lengths=cache.lengths + e
+                )
+
+                out_len = out_len + e
+                done = done | (live & (eos_first < e)) | (out_len >= max_new)
+                counters = counters + jnp.stack([
+                    k * jnp.sum(live, dtype=jnp.int32),
+                    jnp.sum(jnp.maximum(e - 1, 0), dtype=jnp.int32),
+                    jnp.ones((), jnp.int32),
+                ])
+                return (step_idx + 1, nc, prev_logits, done, gen, out_len,
+                        counters)
+
+            init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, gen0,
+                    out_len0, counters0)
+            _, _, _, _, gen, out_len, counters = jax.lax.while_loop(
+                cond, body, init
+            )
+            return gen[:, :max_new], out_len, counters
+
+        fn = jax.jit(run)
+        self._compiled[key] = fn
+        return fn
+
     # -- public API ---------------------------------------------------------
 
     def generate(
@@ -314,17 +502,28 @@ class DecodeEngine:
         row_seeds: Optional[Sequence[int]] = None,
         share_prefix: Optional[bool] = None,
         prefix_ids: Optional[Sequence[int]] = None,
+        speculation: Optional[SpeculationConfig] = None,
     ) -> GenerateOutput:
         """Decode a batch of prompts; returns detokenized continuations.
 
         ``row_seeds`` (one per prompt) make each row's sampling independent of
         batch composition: the same (prompt, row_seed, settings) decodes the
         same text whatever else shares the batch. Default: seed + position.
+
+        ``speculation`` overrides the engine default. It engages only for
+        greedy decode (temperature <= 0) — sampled decode silently takes the
+        plain path (``runtime/sampling.speculation_applicable``); the output
+        stream is identical either way, speculation only changes speed.
         """
         settings = settings or ModelSettings()
         max_new = settings.max_tokens if max_new_tokens is None else max_new_tokens
         sampler = SamplerSettings(
             temperature=settings.temperature, top_k=settings.top_k, top_p=settings.top_p
+        )
+        spec = speculation if speculation is not None else self.speculation
+        use_spec = bool(
+            spec is not None and spec.enabled and spec.draft_len > 0
+            and speculation_applicable(sampler) and max_new > 1
         )
 
         # The cache (and, for learned-position models, the position table) holds
@@ -440,7 +639,15 @@ class DecodeEngine:
             row_seeds_arr[:n] = np.asarray(row_seeds, dtype=np.uint64).astype(np.uint32)
 
         prefix_len = len(shared_ids) if shared_ids is not None else 0
-        fn = self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
+
+        def build_fn():
+            if use_spec:
+                return self._spec_decode_fn(
+                    batch, prompt_len, max_new, prefix_len, spec
+                )
+            return self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
+
+        fn = build_fn()
         tokens_j = jnp.asarray(tokens)
         valid_j = jnp.asarray(valid)
         if self.mesh is not None:
@@ -481,15 +688,24 @@ class DecodeEngine:
         live = np.zeros(batch, dtype=bool)
         live[:n] = True
         live_j = jnp.asarray(live)
+        pref_j = jnp.asarray(
+            shared_ids if shared_ids is not None else [], jnp.int32
+        )
+
         def call(f):
+            if use_spec:
+                args = (self.params, tokens_j, valid_j, live_j, shared_layers,
+                        pref_j)
+            else:
+                args = (self.params, tokens_j, valid_j, seeds_j, live_j,
+                        shared_layers)
             if ctx_mesh is not None:
                 with ctx_mesh, nn.logical_axis_rules(self.rules):
-                    return f(self.params, tokens_j, valid_j, seeds_j, live_j,
-                             shared_layers)
-            return f(self.params, tokens_j, valid_j, seeds_j, live_j, shared_layers)
+                    return f(*args)
+            return f(*args)
 
         try:
-            out = call(fn)
+            res = call(fn)
         except Exception as e:  # noqa: BLE001 — VMEM-gate miss fallback
             # The fused decode-attention kernel's eligibility gate is a
             # calibrated VMEM model (ops/decode_attention._block_bytes), not
@@ -515,9 +731,21 @@ class DecodeEngine:
             self._compiled = {
                 k: v for k, v in self._compiled.items() if k[0] == "prefix_kv"
             }
-            fn = self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
-            out = call(fn)
-        out = np.asarray(jax.device_get(out))[:n]
+            fn = build_fn()
+            res = call(fn)
+        spec_stats = None
+        if use_spec:
+            toks_dev, out_len_dev, counters_dev = res
+            out = np.asarray(jax.device_get(toks_dev))[:n]
+            counters = np.asarray(jax.device_get(counters_dev))
+            emitted = int(np.asarray(jax.device_get(out_len_dev))[:n].sum())
+            spec_stats = SpeculationStats(
+                drafted=int(counters[0]), accepted=int(counters[1]),
+                verify_steps=int(counters[2]), emitted=emitted,
+                draft_len=spec.draft_len, ngram_max=spec.ngram_max,
+            )
+        else:
+            out = np.asarray(jax.device_get(res))[:n]
 
         texts = []
         for row in out:
@@ -527,10 +755,13 @@ class DecodeEngine:
                     break
                 ids.append(int(t))
             texts.append(self.tokenizer.decode(ids))
-        stats = {
+        stats: Dict[str, Any] = {
             "batch": batch,
             "prompt_len": prompt_len,
             "prefix_len": prefix_len,
-            "cache_slots": prompt_len + max_new,
+            # spec decode carries draft_len spare slots for the last window
+            "cache_slots": prompt_len + max_new + (spec.draft_len if use_spec else 0),
         }
+        if spec_stats is not None:
+            stats["speculation"] = spec_stats.as_dict()
         return GenerateOutput(texts=texts, tokens=out, steps=max_new, stats=stats)

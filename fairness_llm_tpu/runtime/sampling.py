@@ -28,6 +28,29 @@ class SamplerSettings:
         return self.temperature <= 0.0
 
 
+def speculation_applicable(settings: SamplerSettings) -> bool:
+    """Speculative decoding verifies drafts against GREEDY argmax, so it is
+    exact only for temperature <= 0. Sampled decode (temperature > 0) would
+    need lockstep rejection sampling to preserve the sampled distribution —
+    not implemented — so callers must fall back to the plain decode path.
+    top_k/top_p are irrelevant under greedy (argmax survives any filter)."""
+    return settings.greedy
+
+
+def greedy_accept_length(drafts: jnp.ndarray, greedy: jnp.ndarray) -> jnp.ndarray:
+    """Longest accepted draft prefix for speculative verification.
+
+    ``drafts``: [B, k] proposed tokens. ``greedy``: [B, k] the model's argmax
+    at each verify position — ``greedy[:, i]`` is the token the model would
+    emit AFTER verify input position i, i.e. the check for ``drafts[:, i]``.
+    Returns [B] int32 in [0, k]: the count of leading drafts where every
+    prior draft also matched (one mismatch rejects everything after it).
+    Accepted tokens are exactly what sequential greedy decode would emit,
+    because each accepted position's context is all-accepted."""
+    ok = jnp.cumprod((drafts == greedy).astype(jnp.int32), axis=1)
+    return jnp.sum(ok, axis=1).astype(jnp.int32)
+
+
 def make_sampler(settings: SamplerSettings) -> Callable[[jnp.ndarray, jax.Array], jnp.ndarray]:
     """Build ``sample(logits[B, V], row_rngs[B]) -> tokens[B]``.
 

@@ -164,8 +164,9 @@ def make_sequence_parallel_train_step(
     Returns (init_state, step) like ``make_train_step``. ``step`` requires
     batch % dp == 0 and pads the (shifted) sequence up to a multiple of sp.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
 
     if model_config.weight_quant != "none":
         raise ValueError(
@@ -194,13 +195,12 @@ def make_sequence_parallel_train_step(
         grads = jax.tree.map(lambda g: jax.lax.psum(g, ("dp", "sp")), grads_part)
         return loss, grads
 
-    sharded_grads = shard_map(
+    sharded_grads = compat_shard_map(
         local_grads,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P("dp", "sp"), P("dp", "sp"), P("dp", "sp"),
                   P("dp", "sp"), P("dp", "sp")),
         out_specs=(P(), P()),
-        check_vma=False,
     )
 
     def step(state: TrainState, tokens, valid):

@@ -173,6 +173,71 @@ def _summarize_planes(xs, label: str, top_k: int, device_filter: str) -> List[Tr
     return out
 
 
+@dataclasses.dataclass
+class SpeculationStats:
+    """Prompt-lookup speculative-decoding counters for one decode call (or a
+    whole sweep, via ``merge``) — the observability half of
+    ``runtime/speculative.py``. Surfaced in ``GenerateOutput.stats``
+    ["speculation"] next to the decode-shape byte accounting, aggregated per
+    sweep by ``pipeline.backends.EngineBackend``, and reported by bench.py's
+    ``speculative`` entry.
+
+    - ``drafted``: draft tokens proposed across all verify steps x live rows
+    - ``accepted``: drafted tokens actually emitted (the free ones — every
+      accepted token skips one full decode step's HBM streaming)
+    - ``verify_steps``: compiled verify-forward invocations. The batch
+      decodes in lockstep, so plain decode's while_loop trip count is the
+      MAX per-row token count; ``verify_steps`` replaces that, and the
+      wall-clock win tracks (max row tokens) / verify_steps.
+    - ``emitted``: real tokens produced across all rows (incl. each step's
+      greedy token); ``tokens_per_step`` = emitted / verify_steps is a
+      batch-summed convenience, not the per-row compression ratio.
+    """
+
+    drafted: int = 0
+    accepted: int = 0
+    verify_steps: int = 0
+    emitted: int = 0
+    draft_len: int = 0
+    ngram_max: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+    @property
+    def tokens_per_step(self) -> float:
+        return self.emitted / self.verify_steps if self.verify_steps else 0.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SpeculationStats":
+        """Inverse of ``as_dict`` (computed keys like acceptance_rate are
+        derived, not stored, so they're dropped on the way in)."""
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+    def merge(self, other: "SpeculationStats") -> "SpeculationStats":
+        return SpeculationStats(
+            drafted=self.drafted + other.drafted,
+            accepted=self.accepted + other.accepted,
+            verify_steps=self.verify_steps + other.verify_steps,
+            emitted=self.emitted + other.emitted,
+            draft_len=other.draft_len or self.draft_len,
+            ngram_max=other.ngram_max or self.ngram_max,
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "drafted": self.drafted,
+            "accepted": self.accepted,
+            "verify_steps": self.verify_steps,
+            "emitted": self.emitted,
+            "acceptance_rate": round(self.acceptance_rate, 4),
+            "tokens_per_step": round(self.tokens_per_step, 3),
+            "draft_len": self.draft_len,
+            "ngram_max": self.ngram_max,
+        }
+
+
 @contextlib.contextmanager
 def phase_timer(name: str, sink: Optional[dict] = None) -> Iterator[None]:
     """Wall-clock phase timing (the reference's orchestrator pattern), with an

@@ -137,6 +137,97 @@ def test_zero_length_prefix_is_no_prefix():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
 
+def _oracle_multi(q, k, v, valid, offs, sk=None, sv=None):
+    """Dense reference for the multi-query (speculative verify) kernel mode:
+    query i of row b sees own-cache slot j iff valid AND j <= offs[b] + i;
+    shared-prefix slots are always visible."""
+    B, Q, H, D = q.shape
+    L = k.shape[1]
+    rep = H // k.shape[2]
+    kk = jnp.repeat(k, rep, axis=2)
+    vv = jnp.repeat(v, rep, axis=2)
+    s_own = jnp.einsum("bqhd,blhd->bhql", q, kk) * D ** -0.5
+    j = jnp.arange(L)
+    causal = j[None, None, :] <= offs[:, None, None] + jnp.arange(Q)[None, :, None]
+    allowed = valid[:, None, :] & causal  # [B, Q, L]
+    s_own = jnp.where(allowed[:, None, :, :], s_own, -1e30)
+    if sk is not None:
+        P = sk.shape[0]
+        sk2 = jnp.repeat(sk, rep, axis=1)
+        sv2 = jnp.repeat(sv, rep, axis=1)
+        s_sh = jnp.einsum("bqhd,phd->bhqp", q, sk2) * D ** -0.5
+        s = jnp.concatenate([s_sh, s_own], axis=-1)
+        vj = jnp.concatenate(
+            [jnp.broadcast_to(sv2[None], (B, P, H, D)), vv], axis=1
+        )
+    else:
+        s, vj = s_own, vv
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhql,blhd->bqhd", p, vj)
+
+
+@pytest.mark.parametrize("shared_p", [None, 96])
+@pytest.mark.parametrize("hkv", [2, 4])
+def test_multi_query_kernel_matches_dense_oracle(shared_p, hkv):
+    """q_len > 1 (speculative verify window) with per-row causal offsets."""
+    rng = np.random.default_rng(3)
+    B, Q, H, D, L = 8, 4, 4, 64, 256
+    q = jnp.asarray(rng.normal(size=(B, Q, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    offs = jnp.asarray(rng.integers(1, L - Q, size=B).astype(np.int32))
+    # valid: everything at/below the verify window (the engine invariant),
+    # with a few earlier holes to exercise the mask AND.
+    j = np.arange(L)[None, :]
+    valid_np = j <= (np.asarray(offs)[:, None] + Q - 1)
+    valid_np &= rng.random((B, L)) < 0.9
+    valid_np[:, 0] = True
+    valid = jnp.asarray(valid_np)
+    shared = None
+    if shared_p:
+        sk = jnp.asarray(rng.normal(size=(shared_p, hkv, D)).astype(np.float32))
+        sv = jnp.asarray(rng.normal(size=(shared_p, hkv, D)).astype(np.float32))
+        shared = (sk, sv)
+    got = decode_attention(q, k, v, valid, shared, q_offsets=offs, interpret=True)
+    want = _oracle_multi(q, k, v, valid, offs, *(shared or (None, None)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_multi_query_kernel_int8_cache_matches_dequant_oracle():
+    rng = np.random.default_rng(4)
+    B, Q, H, hkv, D, L = 8, 3, 4, 2, 64, 256
+    q = jnp.asarray(rng.normal(size=(B, Q, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, hkv, D)).astype(np.float32))
+    offs = jnp.asarray(rng.integers(0, L - Q, size=B).astype(np.int32))
+    valid = jnp.asarray(rng.random((B, L)) < 0.8).at[:, 0].set(True)
+
+    from fairness_llm_tpu.models.transformer import _dequantize_kv, _quantize_kv
+
+    qk, ks = _quantize_kv(k)
+    qv, vs = _quantize_kv(v)
+    got = decode_attention(
+        q, qk, qv, valid, None, k_scale=ks, v_scale=vs, q_offsets=offs,
+        interpret=True,
+    )
+    want = _oracle_multi(
+        q, _dequantize_kv(qk, ks, jnp.float32),
+        _dequantize_kv(qv, vs, jnp.float32), valid, offs,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_multi_query_requires_offsets_and_gate_accounts_q():
+    q4 = jnp.zeros((8, 4, 4, 64), jnp.float32)
+    k = jnp.zeros((8, 128, 2, 64), jnp.float32)
+    valid = jnp.ones((8, 128), bool)
+    with pytest.raises(ValueError, match="q_offsets"):
+        decode_attention(q4, k, k, valid, interpret=True)
+    # the VMEM model must charge q_len (a huge window fails where q=1 passes)
+    assert decode_attn_supported(48, 4096, 64, kv_itemsize=1, q_len=1)
+    assert decode_attn_supported(48, 256, 64, q_len=9)
+
+
 def test_model_gate_off_by_default_and_off_paths():
     """The model only takes the kernel on TPU + flag + compatible config;
     in this CPU suite the gate must always be False so decode behavior (and

@@ -13,6 +13,8 @@ Pinned properties:
 import zlib
 
 import pytest
+
+pytest.importorskip("hypothesis")  # property tests skip where hypothesis isn't baked in
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

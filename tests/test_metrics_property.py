@@ -7,6 +7,9 @@ reference's committed values; this pins the MATH for everything else).
 import math
 
 import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests skip where hypothesis isn't baked in
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -50,19 +50,18 @@ def test_ring_gqa_and_window(eight_device_mesh):
     valid = jnp.ones((b, s), bool)
     window = 7
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
     import functools
 
     from fairness_llm_tpu.parallel.ring import ring_attention
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
 
-    fn = shard_map(
+    fn = compat_shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True, window=window),
-        mesh=mesh,
+        mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp"), P(None, "sp"),
                   P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp"),
-        check_vma=False,
     )
     ring = np.asarray(fn(q, k, v, positions, positions, valid))
 

@@ -1,0 +1,231 @@
+"""Speculative decoding: parity with plain greedy decode + n-gram lookup unit
+tests.
+
+The correctness contract (ISSUE 1) is *token-for-token identity with greedy
+decode* — speculation may only change speed. The parity tests pin that across
+batch sizes, shared-prefix on/off, early-EOS rows, and the dp×tp mesh; the
+lookup tests pin the drafting math on synthetic repetitive prompts.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import ModelSettings, SpeculationConfig
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.models.tokenizer import ByteTokenizer
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.runtime.sampling import (
+    SamplerSettings,
+    greedy_accept_length,
+    speculation_applicable,
+)
+from fairness_llm_tpu.runtime.speculative import ngram_draft
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+GREEDY = ModelSettings(temperature=0.0, max_tokens=24)
+SPEC = SpeculationConfig(enabled=True, ngram_max=3, draft_len=4)
+
+
+# -- parity with plain greedy decode ----------------------------------------
+
+
+@pytest.mark.parametrize("nprompts", [1, 3, 9])
+def test_spec_matches_greedy_across_batch_sizes(engine, nprompts):
+    prompts = [
+        "the quick brown fox", "hi", "abc abc abc abc abc abc",
+        "a much longer prompt that shifts padding around quite a bit",
+        "movies", "fairness", "one two three one two three",
+        "zz", "recommend ten films please",
+    ][:nprompts]
+    plain = engine.generate(prompts, GREEDY)
+    spec = engine.generate(prompts, GREEDY, speculation=SPEC)
+    np.testing.assert_array_equal(plain.tokens, spec.tokens)
+    assert "speculation" in spec.stats and "speculation" not in plain.stats
+
+
+@pytest.mark.parametrize("share", [False, True])
+def test_spec_matches_greedy_with_shared_prefix(engine, share):
+    common = "shared instruction block " * 8
+    prompts = [common + f"user {i} tail" for i in range(5)]
+    plain = engine.generate(prompts, GREEDY, share_prefix=share)
+    spec = engine.generate(prompts, GREEDY, share_prefix=share, speculation=SPEC)
+    np.testing.assert_array_equal(plain.tokens, spec.tokens)
+    if share:
+        assert spec.stats["prefix_len"] > 0  # the prefix path actually ran
+
+
+def test_spec_matches_greedy_with_early_eos(engine):
+    """Rows must stop at EOS mid-window exactly like the plain loop (EOS
+    recorded, pads after). A random model rarely samples the real EOS, so
+    re-tokenize with an eos_id chosen FROM the plain greedy stream — same
+    params, same argmaxes, but now one row provably hits EOS mid-decode."""
+    prompts = ["the quick brown fox", "hi there", "abc"]
+    plain0 = engine.generate(prompts, GREEDY)
+    eos = int(plain0.tokens[0][5])  # appears mid-stream in row 0
+
+    tok = ByteTokenizer(512)
+    tok.eos_id = eos
+    eng2 = DecodeEngine(
+        get_model_config("tiny-test"), params=engine.params, tokenizer=tok
+    )
+    plain = eng2.generate(prompts, GREEDY)
+    spec = eng2.generate(prompts, GREEDY, speculation=SPEC)
+    np.testing.assert_array_equal(plain.tokens, spec.tokens)
+    # the early-EOS case genuinely occurred: row 0 stops, pads after EOS
+    row = list(plain.tokens[0])
+    assert eos in row
+    after = row[row.index(eos) + 1 :]
+    assert all(t == tok.pad_id for t in after)
+    assert len(after) > 0
+
+
+def test_spec_sharded_matches_unsharded(engine, eight_device_mesh):
+    cfg = get_model_config("tiny-test")
+    sharded = DecodeEngine(cfg, params=engine.params, mesh=eight_device_mesh)
+    prompts = ["the quick brown fox", "hi there", "fairness", "movies"]
+    a = engine.generate(prompts, GREEDY, speculation=SPEC)
+    b = sharded.generate(prompts, GREEDY, speculation=SPEC)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+def test_spec_repetitive_prompt_accepts_drafts(engine):
+    """A decode that settles into repetition (what a prompt full of repeated
+    structure induces) must actually ACCEPT lookup drafts — acceptance is
+    what makes speculation a perf feature rather than dead weight."""
+    g = ModelSettings(temperature=0.0, max_tokens=48)
+    common = "list list list list " * 6
+    prompts = [common + "a", common + "b"]
+    plain = engine.generate(prompts, g)
+    spec = engine.generate(prompts, g, speculation=SPEC)
+    np.testing.assert_array_equal(plain.tokens, spec.tokens)
+    st = spec.stats["speculation"]
+    assert st["accepted"] > 0
+    assert st["verify_steps"] < 48  # strictly fewer loop trips than plain
+    assert 0.0 < st["acceptance_rate"] <= 1.0
+    assert st["emitted"] == int(np.sum(spec.tokens != engine.tokenizer.pad_id))
+
+
+def test_spec_temperature_falls_back_to_plain_sampling(engine):
+    """Sampled settings take the plain path byte-for-byte (same programs,
+    same row-seed streams) and report no speculation stats."""
+    s = ModelSettings(temperature=0.9, max_tokens=10)
+    a = engine.generate(["hello there"], s, row_seeds=[123])
+    b = engine.generate(["hello there"], s, row_seeds=[123], speculation=SPEC)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    assert "speculation" not in b.stats
+    assert not speculation_applicable(SamplerSettings(temperature=0.9))
+    assert speculation_applicable(SamplerSettings(temperature=0.0))
+
+
+def test_spec_compile_keys_disjoint(engine):
+    """The satellite fix: the compile key's leading tag is the speculation
+    slot — speculative and plain programs live under disjoint keys, so
+    toggling speculation can never reuse a stale compiled step."""
+    engine.generate(["hi"], GREEDY)
+    engine.generate(["hi"], GREEDY, speculation=SPEC)
+    kinds = {k[0] for k in engine._compiled if isinstance(k[0], str)}
+    assert "decode" in kinds and "spec_decode" in kinds
+    spec_keys = [k for k in engine._compiled if k[0] == "spec_decode"]
+    assert all((SPEC.ngram_max, SPEC.draft_len) == k[-2:] for k in spec_keys)
+
+
+def test_engine_backend_accumulates_spec_totals(engine):
+    """The sweep-level observability chain: EngineBackend merges per-call
+    counters into spec_totals (what phase 1/2 record in result metadata)."""
+    from fairness_llm_tpu.pipeline.backends import EngineBackend
+
+    be = EngineBackend(engine, name="tiny-test", speculation=SPEC)
+    be.generate(["abc abc abc abc"], GREEDY, keys=["a"])
+    steps1 = be.spec_totals.verify_steps
+    be.generate(["def def def def"], GREEDY, keys=["b"])
+    assert be.spec_totals.verify_steps > steps1
+    assert set(be.spec_totals.as_dict()) >= {
+        "drafted", "accepted", "acceptance_rate", "verify_steps", "emitted",
+    }
+    # sampled settings must not touch the totals (plain path, no stats)
+    before = be.spec_totals.as_dict()
+    be.generate(["xyz"], ModelSettings(temperature=0.8, max_tokens=6), keys=["c"])
+    assert be.spec_totals.as_dict() == before
+
+
+# -- n-gram lookup unit tests ------------------------------------------------
+
+
+def _draft(ctx, valid, hist_end, k=4, n=3, pad=0):
+    return np.asarray(ngram_draft(
+        jnp.asarray(ctx, jnp.int32), jnp.asarray(valid, bool),
+        jnp.asarray(hist_end, jnp.int32), k, n, pad,
+    ))
+
+
+def test_ngram_draft_repetitive_history():
+    # history: 5 6 7 5 6 7 5 6 — suffix [7 5 6] matches ending at position 4,
+    # drafts continue from position 5: [7 5 6]; the 4th draft position (8)
+    # lies beyond hist_end, so it pads (drafts only source from history).
+    ctx = np.array([[5, 6, 7, 5, 6, 7, 5, 6, 0, 0, 0, 0]])
+    valid = ctx != 0
+    out = _draft(ctx, valid, [8])
+    np.testing.assert_array_equal(out[0], [7, 5, 6, 0])
+
+
+def test_ngram_draft_prefers_longest_ngram():
+    # suffix ...9 2 3 matches once (after 1), but the 1-gram 3 also occurs
+    # later followed by 8 — the 3-gram match must win.
+    ctx = np.array([[9, 2, 3, 4, 3, 8, 9, 2, 3, 0, 0, 0]])
+    valid = ctx != 0
+    out = _draft(ctx, valid, [9])
+    np.testing.assert_array_equal(out[0], [4, 3, 8, 9])
+
+
+def test_ngram_draft_no_match_gives_pads():
+    ctx = np.array([[1, 2, 3, 4, 5, 6, 7, 8]])
+    out = _draft(ctx, np.ones_like(ctx, bool), [8], pad=0)
+    np.testing.assert_array_equal(out[0], [0, 0, 0, 0])
+
+
+def test_ngram_draft_window_must_be_valid_and_pads_at_gaps():
+    # A matching window containing an invalid position must not match; and a
+    # draft that reads across a pad gap yields pad at the invalid slots
+    # (verification then simply rejects from there on).
+    ctx = np.array([[5, 6, 7, 9, 9, 5, 6, 7, 8, 5, 6, 7]])
+    valid = np.ones_like(ctx, bool)
+    valid[0, 0] = False  # the window [5 6 7] ending at 2 straddles the gap
+    out = _draft(ctx, valid, [12])
+    # earliest VALID match of suffix [5 6 7] ends at position 7 -> draft 8 5 6 7
+    np.testing.assert_array_equal(out[0], [8, 5, 6, 7])
+
+
+def test_ngram_draft_truncates_at_history_end():
+    # match near the end of history: drafts past hist_end are pads
+    ctx = np.array([[1, 2, 3, 1, 2, 3, 0, 0, 0, 0]])
+    valid = ctx != 0
+    out = _draft(ctx, valid, [6], pad=0)
+    # suffix [3 1 2]? hist is 1 2 3 1 2 3: suffix (n=3) = [1 2 3] wait —
+    # last three = [1, 2, 3] at positions 3..5; match ends at position 2,
+    # drafts = positions 3..6 = [1, 2, 3, pad]
+    np.testing.assert_array_equal(out[0], [1, 2, 3, 0])
+
+
+def test_ngram_draft_per_row_independent():
+    ctx = np.array([
+        [5, 6, 7, 5, 6, 7, 5, 6, 0, 0, 0, 0],
+        [1, 2, 3, 4, 5, 6, 7, 8, 0, 0, 0, 0],
+    ])
+    valid = ctx != 0
+    out = _draft(ctx, valid, [8, 8])
+    np.testing.assert_array_equal(out[0], [7, 5, 6, 0])
+    np.testing.assert_array_equal(out[1], [0, 0, 0, 0])
+
+
+def test_greedy_accept_length():
+    drafts = jnp.asarray([[4, 5, 6], [4, 9, 6], [9, 5, 6], [4, 5, 6]])
+    greedy = jnp.asarray([[4, 5, 6], [4, 5, 6], [4, 5, 6], [4, 5, 9]])
+    np.testing.assert_array_equal(
+        np.asarray(greedy_accept_length(drafts, greedy)), [3, 1, 0, 2]
+    )

@@ -51,6 +51,16 @@ def prove(model_name: str = "llama3-70b-int8", batch: int = 8,
     from fairness_llm_tpu.ops.quant_matmul import force_pallas
     from fairness_llm_tpu.parallel import sharding as shd
 
+    # jax 0.4.x jaxlib SIGABRTs (a fatal Mosaic layout check, not a Python
+    # error) compiling these programs against a TPU topology descriptor —
+    # fail as a catchable error so bench.py's fail-soft wrapper records
+    # "lowering unavailable" instead of the whole bench process dying.
+    if tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 6):
+        raise RuntimeError(
+            f"TPU-topology AOT compile needs jax >= 0.6 (have {jax.__version__}; "
+            "0.4.x jaxlib hard-crashes in Mosaic on these programs)"
+        )
+
     cfg = get_model_config(model_name)
     if num_layers is not None:
         cfg = dataclasses.replace(
