@@ -120,6 +120,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --speculate: drafted tokens verified per step")
     p.add_argument("--ngram-max", type=int, default=None,
                    help="with --speculate: longest lookup n-gram tried first")
+    p.add_argument("--continuous", action="store_true",
+                   help="serve engine backends through the continuous-"
+                        "batching scheduler (serving/): fixed KV slot pool, "
+                        "per-step eviction + backfill from a bounded "
+                        "admission queue. Greedy output is token-for-token "
+                        "identical to the static engine for prompts within "
+                        "the serving budget (longer ones truncate, with a "
+                        "warning); see docs/SERVING.md")
+    p.add_argument("--slots", type=int, default=None,
+                   help="with --continuous: concurrent KV slots "
+                        "(= decode-step batch rows)")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--weight-quant", default=None, choices=("none", "int8"),
@@ -177,6 +188,17 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--ngram-max must be >= 1")
             spec_kwargs["ngram_max"] = args.ngram_max
         updates["speculation"] = SpeculationConfig(**spec_kwargs)
+    if args.continuous or args.slots is not None:
+        from fairness_llm_tpu.config import ServingConfig
+
+        if not args.continuous:
+            raise SystemExit("--slots requires --continuous")
+        serve_kwargs = {"enabled": True}
+        if args.slots is not None:
+            if args.slots < 1:
+                raise SystemExit("--slots must be >= 1")
+            serve_kwargs["num_slots"] = args.slots
+        updates["serving"] = ServingConfig(**serve_kwargs)
     if updates:
         config = dataclasses.replace(config, **updates)
     return config

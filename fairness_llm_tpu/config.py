@@ -50,6 +50,37 @@ class SpeculationConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Continuous-batching server knobs (``serving/``).
+
+    The static engine pads every row of a ``generate`` call to the longest
+    prompt and holds the whole batch until the last row drains; the serving
+    subsystem instead runs a fixed pool of ``num_slots`` KV slots, evicts a
+    row the step it finishes, and backfills the freed slot from a bounded
+    admission queue — so a mixed-length workload decodes at per-request cost
+    instead of per-chunk-maximum cost. Greedy decode through the server is
+    token-for-token identical to ``DecodeEngine.generate`` alone for
+    prompts within ``max_prompt_len`` (pinned in tests/test_serving.py;
+    longer prompts left-truncate to the serving budget, with a warning).
+    """
+
+    enabled: bool = False
+    num_slots: int = 8  # concurrent KV slots = decode-step batch rows
+    queue_capacity: int = 128  # bounded admission queue (backpressure past this)
+    max_prompt_len: int = 512  # per-request prompt budget (left-truncated over)
+    max_new_tokens: int = 256  # hard per-request decode cap (requests clamp to it)
+    prefill_group: int = 8  # max admissions prefilled in one compiled forward
+    # Decode steps per compiled scheduler call: larger chunks amortize
+    # per-call dispatch/copy overhead, smaller chunks backfill freed slots
+    # sooner (an evicted row's slot idles at most decode_chunk-1 steps).
+    decode_chunk: int = 8
+    # Optional admission rate limit (RateLimiter.try_acquire at submit);
+    # None = no quota. Exists for parity with the reference's API-era
+    # limiter and for multi-tenant deployments.
+    admission_per_minute: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes follow the scaling-book convention:
 
@@ -137,6 +168,10 @@ class Config:
     speculation: SpeculationConfig = dataclasses.field(
         default_factory=SpeculationConfig
     )
+    # Continuous-batching serving (off by default: sweeps that fit one static
+    # batch shape lose nothing, and the static path remains the reference
+    # numerics). --continuous on the CLI flips enabled.
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
 
     def settings_for(self, model_name: str) -> ModelSettings:
         for name, settings in self.model_settings:

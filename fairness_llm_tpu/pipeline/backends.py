@@ -104,6 +104,10 @@ def shared_prefix_ids(backend, prompts: Sequence[str]) -> Optional[List[int]]:
     engine = getattr(backend, "engine", None)
     if engine is None or len(prompts) < 2:
         return None
+    if not getattr(backend, "use_shared_prefix", True):
+        # ServingBackend decodes rows independently and ignores prefix_ids;
+        # tokenizing the whole sweep for an unused LCP is pure waste.
+        return None
     from fairness_llm_tpu.runtime.engine import _token_lcp
 
     rows = [engine.tokenizer.encode(p) for p in prompts]
@@ -307,6 +311,17 @@ def backend_for(
     from fairness_llm_tpu.runtime.engine import DecodeEngine
 
     model_config = get_model_config(model_name)
+    serving = getattr(config, "serving", None)
+    use_serving = serving is not None and serving.enabled
+    if use_serving and config.mesh.num_devices > 1:
+        # Fail BEFORE the mesh is built and a (possibly sharded) checkpoint
+        # is loaded — the scheduler would reject the mesh at first generate()
+        # anyway, minutes of weight loading later.
+        raise ValueError(
+            "--continuous serving supports single-device engines only "
+            "(the KV slot scatter is not dp-aware yet); drop --mesh or "
+            "run without --continuous"
+        )
     if getattr(config, "weight_quant", None) is not None:
         # Explicit override in EITHER direction: "int8" quantizes a float
         # config, "none" forces float serving for e.g. llama3-70b-int8.
@@ -342,6 +357,14 @@ def backend_for(
         seed=config.random_seed,
         assume_sharded=loaded_sharded,
     )
+    if use_serving:
+        # Continuous-batching server (--continuous): same DecodeBackend
+        # surface, slot-recycled decode underneath. Single-device only
+        # (rejected above, before the weight load); speculation doesn't
+        # compose with the step-wise serving loop yet, so it is ignored.
+        from fairness_llm_tpu.serving import ServingBackend
+
+        return ServingBackend(engine, serving, name=model_name)
     # Speculation rides on the backend (not the engine default) so sweeps
     # opted in via Config get it while direct engine users stay explicit.
     spec = getattr(config, "speculation", None)

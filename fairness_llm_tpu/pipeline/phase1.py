@@ -211,6 +211,8 @@ def run_phase1(
         # Reused/injected backends may carry speculation counters from
         # earlier runs; this record is THIS sweep's decodes only.
         backend.spec_totals = None
+    if hasattr(backend, "serve_totals"):
+        backend.serve_totals = None  # same reset for serving counters
     done = R.load_latest_checkpoint(config.results_dir, "phase1") if resume else {}
     recs = decode_sweep(
         backend,
@@ -293,6 +295,12 @@ def run_phase1(
             "speculation": (
                 backend.spec_totals.as_dict()
                 if getattr(backend, "spec_totals", None) is not None else None
+            ),
+            # continuous-batching serving counters for the whole sweep
+            # (None unless the sweep ran through a ServingBackend)
+            "serving": (
+                backend.serve_totals.as_dict()
+                if getattr(backend, "serve_totals", None) is not None else None
             ),
         },
         "profiles": [p.to_dict() for p in profiles],

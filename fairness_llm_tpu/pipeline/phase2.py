@@ -404,6 +404,8 @@ def run_phase2(
             # Reused/injected backends may carry counters from earlier
             # phases; this record is THIS evaluation's decodes only.
             backend.spec_totals = None
+        if hasattr(backend, "serve_totals"):
+            backend.serve_totals = None  # same reset for serving counters
         model_results[name] = evaluate_model(
             backend, items, num_comparisons, settings,
             seed=config.random_seed, num_queries=num_queries,
@@ -414,6 +416,11 @@ def run_phase2(
         spec_totals = getattr(backend, "spec_totals", None)
         if spec_totals is not None:
             model_results[name]["speculation"] = spec_totals.as_dict()
+        # Serving counters (queue/slot/step observability) when this model
+        # evaluated through the continuous-batching server.
+        serve_totals = getattr(backend, "serve_totals", None)
+        if serve_totals is not None:
+            model_results[name]["serving"] = serve_totals.as_dict()
 
     comparison = compare_models_and_methods(model_results)
     results = {

@@ -1,0 +1,23 @@
+"""Continuous-batching serving subsystem.
+
+Layers (bottom-up): ``request`` (Request/Result wire format) -> ``queue``
+(bounded admission + rate limiting) -> ``slots`` (KV slot pool allocator)
+-> ``scheduler`` (the prefill/decode step loop) -> ``backend`` (the
+``DecodeBackend`` adapter the pipeline phases consume). See docs/SERVING.md.
+"""
+
+from fairness_llm_tpu.serving.backend import ServingBackend
+from fairness_llm_tpu.serving.queue import AdmissionQueue
+from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
+from fairness_llm_tpu.serving.slots import SlotPool, SlotState
+
+__all__ = [
+    "AdmissionQueue",
+    "ContinuousScheduler",
+    "Request",
+    "Result",
+    "ServingBackend",
+    "SlotPool",
+    "SlotState",
+]

@@ -1,0 +1,127 @@
+"""``ServingBackend``: the continuous-batching server behind the
+``DecodeBackend`` protocol (``pipeline/backends.py:31``), so phases 1-3 run
+through the server unchanged — ``backend_for`` returns one when
+``Config.serving.enabled`` (CLI ``--continuous``).
+
+Differences from ``EngineBackend`` that callers should know:
+
+- each row decodes independently in its own KV slot; the sweep-wide shared
+  prefix (``prefix_ids``) is accepted and IGNORED — serving trades the
+  prefix-KV read sharing for slot-recycling throughput, and greedy output
+  is token-for-token identical either way only when the engine also decodes
+  without a shared prefix (the parity contract is vs
+  ``DecodeEngine.generate`` alone, which is how the tests pin it).
+- per-request failures come back as ``None`` texts (the
+  ``with_failure_containment`` sentinel convention) instead of failing the
+  chunk, because the scheduler already contains faults per-request.
+- serving counters accumulate in ``serve_totals`` (a ``ServingStats``)
+  exactly like ``EngineBackend.spec_totals``, and the last call's
+  ``GenerateOutput`` (with ``stats["serving"]``) is kept on
+  ``last_output`` for byte/shape accounting.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from fairness_llm_tpu.config import ModelSettings, ServingConfig
+from fairness_llm_tpu.serving.request import Request
+from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class ServingBackend:
+    # decode_sweep's shared_prefix_ids checks this before computing the
+    # sweep-wide token LCP — serving ignores prefix_ids, so don't pay for it.
+    use_shared_prefix = False
+
+    def __init__(self, engine, serving: Optional[ServingConfig] = None,
+                 name: Optional[str] = None, fault_injector=None):
+        self.engine = engine
+        self.serving = serving or ServingConfig(enabled=True)
+        self.name = name or engine.config.name
+        self.fault_injector = fault_injector
+        self.serve_totals = None  # Optional[ServingStats], set lazily
+        self.last_output = None  # GenerateOutput of the most recent call
+        self._schedulers: dict = {}
+
+    def scheduler_for(self, settings: ModelSettings) -> ContinuousScheduler:
+        """One scheduler per sampler tuple (sampling is compiled into the
+        step program). The persistent KV pool is the scheduler's dominant
+        memory, so only a small working set is kept (LRU, like the engine's
+        prefix-KV cache)."""
+        key = (settings.temperature, settings.top_k, settings.top_p)
+        sched = self._schedulers.get(key)
+        if sched is not None:
+            self._schedulers[key] = self._schedulers.pop(key)  # LRU refresh
+            return sched
+        sched = ContinuousScheduler(
+            self.engine, self.serving, settings=settings,
+            fault_injector=self.fault_injector,
+        )
+        keys = list(self._schedulers)
+        while len(keys) >= 2:
+            del self._schedulers[keys.pop(0)]
+        self._schedulers[key] = sched
+        return sched
+
+    def generate(
+        self,
+        prompts: Sequence[str],
+        settings: Optional[ModelSettings] = None,
+        seed: int = 0,
+        keys: Optional[Sequence[str]] = None,
+        prefix_ids: Optional[Sequence[int]] = None,  # accepted, unused
+    ) -> List[Optional[str]]:
+        from fairness_llm_tpu.pipeline.backends import _stable_hash
+        from fairness_llm_tpu.runtime.engine import GenerateOutput
+
+        settings = settings or ModelSettings()
+        if not prompts:
+            self.last_output = GenerateOutput(
+                texts=[], tokens=np.zeros((0, 0), np.int32), steps=0
+            )
+            return []
+        sched = self.scheduler_for(settings)
+        requests = []
+        for i, p in enumerate(prompts):
+            if keys is not None:
+                # Same row-seed formula as EngineBackend: stable identity,
+                # so resumed sweeps reproduce uninterrupted ones.
+                rid, row_seed = keys[i], (_stable_hash(keys[i]) ^ seed) & 0xFFFFFFFF
+            else:
+                rid, row_seed = f"call{seed}_{i:05d}", (seed * 1_000_003 + i) & 0xFFFFFFFF
+            requests.append(Request(
+                prompt=p, id=rid, settings=settings, row_seed=row_seed
+            ))
+        results = sched.serve(requests)
+        stats = sched.last_stats
+        if stats is not None:
+            self.serve_totals = (
+                stats if self.serve_totals is None
+                else self.serve_totals.merge(stats)
+            )
+        cap = max((len(r.tokens) for r in results), default=0)
+        toks = np.full((len(results), cap), self.engine.tokenizer.pad_id,
+                       np.int32)
+        for i, r in enumerate(results):
+            toks[i, : len(r.tokens)] = r.tokens
+        self.last_output = GenerateOutput(
+            texts=[r.text if r.ok else "" for r in results],
+            tokens=toks,
+            steps=sched.serving.max_new_tokens,
+            stats={
+                "batch": sched.num_slots,
+                "prompt_len": sched.max_prompt_bucket,
+                "prefix_len": 0,
+                "cache_slots": sched.cache_len,
+                "serving": stats.as_dict() if stats is not None else None,
+            },
+        )
+        # None (not "") for failed rows — the decode_sweep/failure-containment
+        # sentinel convention, so resumes retry them.
+        return [r.text if r.ok else None for r in results]

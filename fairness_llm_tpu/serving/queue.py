@@ -1,0 +1,85 @@
+"""Bounded admission queue with backpressure and optional rate limiting.
+
+The reference throttled its 45 sequential API calls with a blocking
+sliding-window limiter (``utils.py:386-408``); a local server must instead
+REJECT at admission — blocking the scheduler's step loop to pace one new
+request would stall every request already decoding. ``submit`` is therefore
+non-blocking: it returns False (and counts a rejection) when the queue is at
+capacity or the ``RateLimiter.try_acquire`` quota says no, and the caller
+decides whether to retry, shed, or apply its own backoff.
+
+Single-threaded by design: the scheduler loop is the only consumer, so this
+is a deque with explicit capacity, not a synchronized queue. Requeued
+requests (fault containment) re-enter at the FRONT so a retry doesn't go to
+the back of a long line it already waited through.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional
+
+from fairness_llm_tpu.serving.request import Request
+from fairness_llm_tpu.utils.ratelimit import RateLimiter
+
+
+class AdmissionQueue:
+    def __init__(
+        self,
+        capacity: int = 128,
+        rate_limiter: Optional[RateLimiter] = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.rate_limiter = rate_limiter
+        self._q: Deque[Request] = deque()
+        self.rejected = 0  # capacity + rate rejections, for ServingStats
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def full(self) -> bool:
+        return len(self._q) >= self.capacity
+
+    def submit(self, request: Request, count_rejection: bool = True) -> bool:
+        """Admit ``request`` to the back of the queue; False = backpressure
+        (queue full or rate quota exhausted), nothing enqueued.
+
+        ``count_rejection=False`` is for internal retries of an
+        already-accepted request (the scheduler's pending-overflow top-up):
+        the attempt still respects capacity and quota, but a refusal is not
+        a new rejection for the stats."""
+        if self.full:
+            if count_rejection:
+                self.rejected += 1
+            return False
+        if self.rate_limiter is not None and not self.rate_limiter.try_acquire():
+            if count_rejection:
+                self.rejected += 1
+            return False
+        self._q.append(request)
+        return True
+
+    def requeue(self, request: Request) -> None:
+        """Front-of-line reinsertion for a fault-requeued request. Bypasses
+        capacity and rate checks: the request was already admitted once, and
+        dropping it here would turn fault containment into silent loss."""
+        self._q.appendleft(request)
+
+    def pop(self, n: int = 1) -> List[Request]:
+        """Dequeue up to ``n`` requests FIFO (fewer when the queue is short)."""
+        out = []
+        while self._q and len(out) < n:
+            out.append(self._q.popleft())
+        return out
+
+    def drain_expired(self, now: Optional[float] = None) -> List[Request]:
+        """Remove and return every queued request whose deadline has passed
+        (the scheduler fails them without spending a prefill on them)."""
+        keep, expired = deque(), []
+        for r in self._q:
+            (expired if r.expired(now) else keep).append(r)
+        self._q = keep
+        return expired

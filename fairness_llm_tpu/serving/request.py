@@ -1,0 +1,89 @@
+"""Request/Result dataclasses — the serving subsystem's wire format.
+
+A ``Request`` is one prompt with its own decode budget, sampling seed, and
+optional deadline; a ``Result`` is its terminal outcome (tokens + text on
+success, a reason string on failure). The scheduler owns the lifecycle:
+queued -> admitted (KV slot + prefill) -> decoding -> completed/failed, with
+at most one automatic requeue after an injected/transient decode fault
+(``utils/failures.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Optional
+
+import numpy as np
+
+from fairness_llm_tpu.config import ModelSettings
+
+_ids = itertools.count()
+
+
+def _auto_id() -> str:
+    return f"req_{next(_ids):06d}"
+
+
+@dataclasses.dataclass
+class Request:
+    """One serving request.
+
+    ``settings`` carries the per-request decode budget (``max_tokens``,
+    clamped to the server's ``ServingConfig.max_new_tokens`` cap). Sampler
+    fields (temperature/top_k/top_p) must match the scheduler's compiled
+    sampler — sampling is baked into the compiled step program, so a request
+    wanting different sampler settings belongs on a different scheduler
+    (``ServingBackend`` manages one per settings tuple).
+
+    ``row_seed`` keys the row's sampling stream on stable request identity —
+    the same (prompt, row_seed, settings) decodes the same text whatever
+    else shares the slot pool, matching the engine's ``row_seeds`` contract.
+
+    ``deadline_s`` is a wall-clock budget relative to submission; an expired
+    request is failed (finish_reason "deadline") instead of decoded, whether
+    it is still queued or mid-decode.
+
+    ``submitted_at`` defaults to construction time but is re-stamped when
+    the request enters the scheduler (``submit()``/``serve()``), so
+    deadlines and reported latencies never include time before the server
+    saw the request. A fault requeue keeps the original stamp — retry time
+    counts against the deadline and shows in the latency.
+    """
+
+    prompt: str
+    id: str = dataclasses.field(default_factory=_auto_id)
+    settings: Optional[ModelSettings] = None
+    row_seed: Optional[int] = None
+    deadline_s: Optional[float] = None
+    submitted_at: float = dataclasses.field(default_factory=time.monotonic)
+    retries: int = 0  # scheduler-owned: requeue count after faults
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_s is None:
+            return False
+        return (now if now is not None else time.monotonic()) \
+            >= self.submitted_at + self.deadline_s
+
+
+@dataclasses.dataclass
+class Result:
+    """Terminal outcome of one request.
+
+    ``tokens`` matches the engine's per-row convention: generated ids
+    including the EOS that stopped the row (when one did), nothing after.
+    ``finish_reason``: "eos" | "length" | "failed" | "deadline".
+    """
+
+    id: str
+    ok: bool
+    text: str = ""
+    tokens: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0,), np.int32)
+    )
+    finish_reason: str = "length"
+    error: Optional[str] = None
+    prompt_tokens: int = 0
+    latency_s: float = 0.0
+    retries: int = 0

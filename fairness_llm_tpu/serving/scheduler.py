@@ -1,0 +1,649 @@
+"""Continuous-batching step loop: prefill/decode interleaving over a slot pool.
+
+The static engine (``runtime/engine.py``) runs one compiled program per
+batch: every row pads to the longest prompt, finished rows burn decode steps
+until the whole chunk drains, and new work waits outside. This scheduler
+instead keeps ONE persistent device cache of ``num_slots`` rows and runs an
+admission loop:
+
+1. expire deadlined requests (queued or mid-decode)
+2. backfill free slots from the admission queue — admitted prompts prefill
+   in groups bucketed by prompt length ([nb, P] compiled shapes, P a
+   multiple of the engine's seq bucket), each row scattering its KV into its
+   slot's row of the shared cache
+3. decode every live slot ``decode_chunk`` steps in one compiled
+   while_loop: per-row sampling streams (seeded on request identity),
+   per-row KV ``write_offsets`` (the machinery the speculative-decoding PR
+   added to the transformer), per-row EOS/budget stopping
+4. evict finished rows, release their slots (device-side ``key_valid``
+   invalidation before reuse), and loop
+
+Compiled-program inventory stays bounded: one decode-step program (slot
+invalidation rides on its reset mask) and one prefill program per
+(batch-bucket, prompt-bucket) pair — independent of workload size or mix.
+
+Greedy parity is the correctness contract (pinned in tests/test_serving.py):
+a request decodes the SAME tokens through the server as through
+``DecodeEngine.generate([prompt])`` alone. It holds by construction: each
+slot reproduces the engine's batch-1 layout exactly — left-padded prompt in
+cache slots [0, P), decode writes at ``P + emitted``, positions counted over
+real tokens, attention masked to the row's own valid keys — and padding
+/ pool composition contribute exact zeros to every reduction.
+
+Sampled decode works too (the per-row fold_in(emitted) key stream equals the
+engine's fold_in(step) stream row-for-row); only sampler SETTINGS are
+per-scheduler, because sampling is baked into the compiled step program.
+
+Fault containment (``utils/failures.py``): an injected or device-raised
+decode/prefill fault releases the hit slots and requeues each request once;
+a second fault surfaces as a failed ``Result``. The loop itself never dies.
+
+Sharded meshes are not supported yet (the slot scatter would need dp-aware
+placement); serving targets the single-chip engine — multi-replica routing
+is the next layer up, not this one.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fairness_llm_tpu.config import ModelSettings, ServingConfig
+from fairness_llm_tpu.models.tokenizer import _left_pad
+from fairness_llm_tpu.models.transformer import LayerCache, init_cache
+from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
+from fairness_llm_tpu.serving.queue import AdmissionQueue
+from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.slots import SlotPool, SlotState
+from fairness_llm_tpu.utils.failures import DecodeFault
+from fairness_llm_tpu.utils.profiling import ServingStats
+from fairness_llm_tpu.utils.ratelimit import RateLimiter
+
+logger = logging.getLogger(__name__)
+
+
+def _bucket_pow2(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class ContinuousScheduler:
+    """Drives one ``DecodeEngine``'s params/model as a continuous server.
+
+    One scheduler = one compiled sampler (``settings`` temperature/top_k/
+    top_p) + one decode-length cap. ``ServingBackend`` keeps one scheduler
+    per settings tuple; direct users construct it around an engine:
+
+        sched = ContinuousScheduler(engine, ServingConfig(num_slots=8))
+        results = sched.serve([Request(prompt=p) for p in prompts])
+    """
+
+    def __init__(
+        self,
+        engine,
+        serving: Optional[ServingConfig] = None,
+        settings: Optional[ModelSettings] = None,
+        fault_injector=None,
+    ):
+        if engine.mesh is not None:
+            raise ValueError(
+                "ContinuousScheduler supports single-device engines only "
+                "(the slot scatter is not dp-aware yet); build the engine "
+                "without a mesh"
+            )
+        self.engine = engine
+        self.serving = serving or ServingConfig(enabled=True)
+        self.settings = settings or ModelSettings()
+        self.sampler = SamplerSettings(
+            temperature=self.settings.temperature,
+            top_k=self.settings.top_k,
+            top_p=self.settings.top_p,
+        )
+        self.fault_injector = fault_injector
+        cfg = engine.config
+        cap = self.serving.max_new_tokens
+        if cap < 1 or cap >= cfg.max_seq_len:
+            raise ValueError(
+                f"max_new_tokens {cap} must be in [1, {cfg.max_seq_len})"
+            )
+        from fairness_llm_tpu.runtime.engine import _bucket_len
+
+        self._bucket_len = _bucket_len
+        # Per-request prompt budget: the serving knob, clamped so the longest
+        # prompt + the decode cap always fit the model's position tables.
+        self.prompt_budget = min(
+            self.serving.max_prompt_len, cfg.max_seq_len - cap
+        )
+        if self.prompt_budget < 1:
+            raise ValueError(
+                f"no prompt budget left: max_seq_len {cfg.max_seq_len} - "
+                f"max_new_tokens {cap} <= 0"
+            )
+        # Largest per-row prompt bucket (cache layout only — the REAL token
+        # budget above is what bounds positions, so a bucket overshooting the
+        # budget just leaves a few always-invalid slots per row).
+        self.max_prompt_bucket = _bucket_len(self.prompt_budget, engine.seq_bucket)
+        self.cache_len = self.max_prompt_bucket + cap
+        self.num_slots = self.serving.num_slots
+        self.pool = SlotPool(self.num_slots)
+        self.queue = AdmissionQueue(
+            capacity=self.serving.queue_capacity,
+            rate_limiter=(
+                RateLimiter(self.serving.admission_per_minute)
+                if self.serving.admission_per_minute else None
+            ),
+        )
+        # Persistent device state: the shared KV cache + each slot's carried
+        # next-token logits (f32 — what the sampler consumes).
+        self._cache = init_cache(cfg, self.num_slots, self.cache_len)
+        self._prev_logits = jnp.zeros(
+            (self.num_slots, cfg.vocab_size), jnp.float32
+        )
+        self._compiled: Dict[tuple, object] = {}
+        # Overflow beyond queue capacity (deque: _feed pops from the head)
+        self._pending: Deque[Request] = deque()
+        self._results: Dict[str, Result] = {}
+        # Rejections already attributed to a previous drain's stats — the
+        # next drain reports only the delta, INCLUDING refusals from public
+        # submit() calls made between drains.
+        self._rejected_taken = 0
+        self.last_stats: Optional[ServingStats] = None
+        # decode_chunk: steps per compiled decode call. Larger chunks
+        # amortize per-call dispatch overhead; smaller chunks backfill
+        # freed slots sooner.
+        self.decode_chunk = max(1, self.serving.decode_chunk)
+
+    # -- compiled programs --------------------------------------------------
+
+    def _donate(self):
+        # Donate the cache + carried logits so each decode chunk updates
+        # in-place instead of copying the whole pool per call (jax >= 0.4.26
+        # implements donation on CPU too; measured ~4 ms/call of pure
+        # memcpy saved for the tiny-gpt2-study pool). The decode failure
+        # path must then REBUILD device state, which _decode's except
+        # branch does.
+        return (1, 2)
+
+    def _prefill_fn(self, nb: int, P: int):
+        """[nb, P] prompt prefill + row scatter into the shared cache.
+
+        Numerically the engine's prefill: left-padded tokens, positions from
+        the valid cumsum, ``last_only`` logits. The fresh [nb, P] cache's
+        post-write rows (k/v/key_valid/key_positions/lengths) scatter into
+        the big cache at ``slots``; slots >= num_slots (batch-bucket pad
+        rows) drop. Rows' tail slots [P, cache_len) are re-invalidated here,
+        so a recycled slot never exposes its previous tenant's keys.
+        """
+        key = ("serve_prefill", nb, P)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.engine.config
+        model = self.engine.model
+
+        def run(params, cache, prev_logits, tokens, valid, slots):
+            positions = jnp.maximum(
+                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
+            )
+            small = init_cache(cfg, nb, P)
+            logits, small = model.apply(
+                {"params": params}, tokens, positions, valid, small,
+                left_padded=True, last_only=True,
+            )
+
+            def scat(big, rows):
+                return big.at[slots, :P].set(rows, mode="drop")
+
+            new_layers = []
+            for bl, sl in zip(cache.layers, small.layers):
+                kw = dict(k=scat(bl.k, sl.k), v=scat(bl.v, sl.v))
+                if bl.k_scale is not None:
+                    kw.update(
+                        k_scale=scat(bl.k_scale, sl.k_scale),
+                        v_scale=scat(bl.v_scale, sl.v_scale),
+                    )
+                new_layers.append(LayerCache(**kw))
+            key_valid = scat(cache.key_valid, small.key_valid)
+            key_valid = key_valid.at[slots, P:].set(False, mode="drop")
+            new_cache = cache.replace(
+                layers=tuple(new_layers),
+                key_valid=key_valid,
+                key_positions=scat(cache.key_positions, small.key_positions),
+                lengths=cache.lengths.at[slots].set(
+                    small.lengths, mode="drop"
+                ),
+            )
+            new_logits = prev_logits.at[slots].set(
+                logits[:, -1, :], mode="drop"
+            )
+            return new_cache, new_logits
+
+        # No donation here even on TPU: a prefill failure must leave the
+        # OTHER live slots' cache rows intact, and a donated input buffer
+        # doesn't survive a raised call.
+        fn = jax.jit(run)
+        self._compiled[key] = fn
+        return fn
+
+    def _step_fn(self):
+        """The decode program: ``decode_chunk`` steps in one while_loop.
+
+        Mirrors the engine's decode body per iteration — sample from the
+        carried logits with the row's own fold_in(emitted) key, forward one
+        token with per-row ``write_offsets = base + emitted``, carry the new
+        logits — but over the slot pool, with per-row stop conditions
+        (EOS or the row's own budget) instead of a batch-uniform cap. Early
+        exit when every live row finishes mid-chunk.
+        """
+        fn = self._compiled.get("serve_step")
+        if fn is not None:
+            return fn
+        cfg = self.engine.config
+        model = self.engine.model
+        sample = make_sampler(self.sampler)
+        pad_id = self.engine.tokenizer.pad_id
+        eos_id = self.engine.tokenizer.eos_id
+        B, T = self.num_slots, self.decode_chunk
+
+        def run(params, cache, prev_logits, row_seeds, emitted0, base, caps,
+                live0, reset):
+            # Fold released-slot invalidation into the step entry (one
+            # program instead of a separate invalidate dispatch + cache
+            # copy per iteration): rows in ``reset`` lose their key_valid/
+            # lengths before any attention can touch them.
+            keep = ~reset
+            cache = cache.replace(
+                key_valid=cache.key_valid & keep[:, None],
+                lengths=cache.lengths * keep.astype(cache.lengths.dtype),
+            )
+            row_keys = jax.vmap(jax.random.key)(row_seeds)
+            toks0 = jnp.full((B, T), pad_id, jnp.int32)
+            done0 = ~live0
+            counters0 = jnp.zeros((2,), jnp.int32)  # steps, live-row-steps
+
+            def cond(carry):
+                t, _, _, done, _, _, _ = carry
+                return (t < T) & ~jnp.all(done)
+
+            def body(carry):
+                t, cache, prev_logits, done, emitted, toks, counters = carry
+                live = ~done
+                step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
+                tok = sample(prev_logits, step_keys)
+                tok = jnp.where(live, tok, pad_id)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[:, None], (jnp.zeros((), jnp.int32), t)
+                )
+                offs = base + emitted
+                pos = cache.lengths[:, None]
+                logits, cache = model.apply(
+                    {"params": params}, tok[:, None], pos, live[:, None],
+                    cache, write_offsets=offs,
+                )
+                prev_logits = jnp.where(
+                    live[:, None], logits[:, -1, :], prev_logits
+                )
+                emitted = emitted + live.astype(jnp.int32)
+                done = done | (tok == eos_id) | (emitted >= caps)
+                counters = counters + jnp.stack(
+                    [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
+                )
+                return (t + 1, cache, prev_logits, done, emitted, toks, counters)
+
+            init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
+                    emitted0, toks0, counters0)
+            _, cache, prev_logits, _, emitted, toks, counters = \
+                jax.lax.while_loop(cond, body, init)
+            return cache, prev_logits, toks, emitted, counters
+
+        fn = jax.jit(run, donate_argnums=self._donate())
+        self._compiled["serve_step"] = fn
+        return fn
+
+    # -- submission ---------------------------------------------------------
+
+    def _check_settings(self, request: Request) -> None:
+        """Sampler-setting mismatches fail loudly — sampling is compiled
+        into the step program, so a mismatched request would silently
+        decode with the wrong temperature."""
+        s = request.settings
+        if s is None:
+            return
+        rs = SamplerSettings(
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p
+        )
+        if rs != self.sampler:
+            raise ValueError(
+                f"request {request.id!r} sampler settings {rs} != "
+                f"scheduler sampler {self.sampler}; use a scheduler "
+                "compiled for those settings"
+            )
+
+    def submit(self, request: Request) -> bool:
+        """Queue one request; False = backpressure (queue full / rate
+        quota). The deadline/latency clock (re)starts here — a Request
+        object built ahead of time doesn't age before the server sees it."""
+        self._check_settings(request)
+        request.submitted_at = time.monotonic()
+        return self.queue.submit(request)
+
+    def take_result(self, request_id: str) -> Optional[Result]:
+        """Claim (and remove) the Result of a request that terminated in an
+        earlier ``serve``/``drain`` — the retrieval path for requests
+        entered via ``submit()`` rather than ``serve()``."""
+        return self._results.pop(request_id, None)
+
+    def drain(self) -> ServingStats:
+        """Run the loop until the queue and slot pool are empty — the
+        companion to ``submit()``. Terminated requests' Results wait in
+        ``take_result``."""
+        stats = ServingStats(num_slots=self.num_slots)
+        self._run_loop(stats)
+        self.last_stats = stats
+        return stats
+
+    def serve(self, requests: Sequence[Request]) -> List[Result]:
+        """Submit ``requests`` and run the loop until every one terminates.
+        Overflow beyond queue capacity waits host-side and feeds in as the
+        queue drains (the queue bound is admission backpressure, not a cap
+        on workload size). Results come back in submission order. Requests
+        already queued via ``submit()`` decode alongside; their Results stay
+        claimable through ``take_result``."""
+        stats = ServingStats(num_slots=self.num_slots)
+        # Validate the whole batch up front (same guard as submit()) so a
+        # mismatched-sampler request fails loudly before any work starts,
+        # and start every request's deadline/latency clock at intake.
+        now = time.monotonic()
+        ids = [r.id for r in requests]
+        if len(set(ids)) != len(ids):
+            # _results is keyed by id; a collision would overwrite one
+            # request's Result and KeyError on return AFTER decoding both.
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            raise ValueError(f"duplicate request ids in serve() batch: {dup}")
+        for r in requests:
+            self._check_settings(r)
+            r.submitted_at = now
+        self._pending = deque(requests)
+        self._run_loop(stats)
+        self.last_stats = stats
+        return [self._results.pop(r.id) for r in requests]
+
+    # -- internals ----------------------------------------------------------
+
+    def _run_loop(self, stats: ServingStats) -> None:
+        self._feed(stats)
+        while self._pending or len(self.queue) or self.pool.occupancy:
+            progressed = self._iterate(stats)
+            self._feed(stats)
+            if not progressed and not self.pool.occupancy:
+                # Rate-limited admission with nothing decoding: yield briefly
+                # instead of spinning the loop dry.
+                time.sleep(0.002)
+        # Attribute queue rejections not yet reported by an earlier drain —
+        # including public submit() refusals made BETWEEN drains (the
+        # single-threaded loop means none can occur during one).
+        stats.rejected = self.queue.rejected - self._rejected_taken
+        self._rejected_taken = self.queue.rejected
+
+    def _feed(self, stats: ServingStats) -> None:
+        # Internal top-up from serve()'s pending overflow: a failed attempt
+        # here is a RETRY of an already-accepted request, not a refused
+        # submission, so it must not count toward stats.rejected (which
+        # records public submit() backpressure).
+        while self._pending and not self.queue.full:
+            if not self.queue.submit(self._pending[0], count_rejection=False):
+                break  # rate-limited; retry next iteration
+            self._pending.popleft()
+
+    def _fail(self, request: Request, reason: str, error: str,
+              stats: ServingStats, tokens: Optional[List[int]] = None) -> None:
+        tok = self.engine.tokenizer
+        ids = list(tokens or [])
+        text = tok.decode([t for t in ids if t != tok.eos_id])
+        self._results[request.id] = Result(
+            id=request.id, ok=False, text=text,
+            tokens=np.asarray(ids, np.int32), finish_reason=reason,
+            error=error, retries=request.retries,
+            latency_s=time.monotonic() - request.submitted_at,
+        )
+        if reason == "deadline":
+            stats.expired += 1
+        else:
+            stats.failed += 1
+
+    def _requeue_or_fail(self, request: Request, error: str,
+                         stats: ServingStats) -> None:
+        if request.retries < 1:
+            request.retries += 1
+            stats.requeued += 1
+            self.queue.requeue(request)
+        else:
+            self._fail(request, "failed", error, stats)
+
+    def _finish(self, slot: int, reason: str, stats: ServingStats) -> None:
+        state = self.pool.release(slot)
+        req = state.request
+        tok = self.engine.tokenizer
+        ids = []
+        for t in state.tokens:
+            ids.append(int(t))
+            if t == tok.eos_id:
+                break
+        text = tok.decode(ids[:-1] if ids and ids[-1] == tok.eos_id else ids)
+        if reason == "deadline":
+            self._fail(req, "deadline", "deadline expired mid-decode",
+                       stats, tokens=ids)
+            return
+        self._results[req.id] = Result(
+            id=req.id, ok=True, text=text,
+            tokens=np.asarray(ids, np.int32), finish_reason=reason,
+            prompt_tokens=state.real_len, retries=req.retries,
+            latency_s=time.monotonic() - req.submitted_at,
+        )
+        stats.completed += 1
+
+    def _cap_for(self, request: Request) -> int:
+        m = (request.settings or self.settings).max_tokens
+        return max(1, min(m, self.serving.max_new_tokens))
+
+    def _admit(self, stats: ServingStats) -> bool:
+        """Backfill free slots from the queue until one side runs dry,
+        prefilling in prompt-bucket groups (``prefill_group`` bounds one
+        compiled batch, not the iteration — leaving slots empty while work
+        is queued would decode below pool capacity for a whole chunk).
+        Returns True when anything was admitted/attempted."""
+        any_admitted = False
+        while True:
+            if not self._admit_once(stats):
+                return any_admitted
+            any_admitted = True
+
+    def _admit_once(self, stats: ServingStats) -> bool:
+        n = min(self.pool.free_count, self.serving.prefill_group,
+                len(self.queue))
+        if n <= 0:
+            return False
+        popped = self.queue.pop(n)
+        tok = self.engine.tokenizer
+        admitted = []  # (request, row ids, P)
+        for req in popped:
+            if self.fault_injector is not None:
+                try:
+                    self.fault_injector.maybe_fail(req.id, "prefill")
+                except DecodeFault as e:
+                    self._requeue_or_fail(req, str(e), stats)
+                    continue
+            ids = tok.encode(req.prompt)
+            if len(ids) > self.prompt_budget:
+                # Keep recency, like the engine's truncation — but the
+                # server budget (ServingConfig.max_prompt_len) can be
+                # tighter than the engine's per-call budget, and a
+                # truncated prompt decodes DIFFERENT tokens than the
+                # engine alone would, so say so instead of silently
+                # breaking the parity contract.
+                logger.warning(
+                    "request %s: prompt (%d tokens) exceeds the serving "
+                    "budget (%d); left-truncating — output will differ "
+                    "from the static engine's for this request",
+                    req.id, len(ids), self.prompt_budget,
+                )
+                ids = ids[-self.prompt_budget:]
+            P = min(
+                self._bucket_len(max(len(ids), 1), self.engine.seq_bucket),
+                self.max_prompt_bucket,
+            )
+            admitted.append((req, ids, P))
+        if not admitted:
+            return False
+
+        # ONE prefill per admission batch, at the max prompt bucket of the
+        # batch. Shorter rows pad up to it — numerically free (pad slots are
+        # masked, contributing exact zeros to every reduction; parity tests
+        # pin this) and much cheaper than a compiled call per bucket when
+        # backfills trickle in one or two rows at a time. A row's ``base``
+        # is therefore the bucket it was PREFILLED at, which its decode
+        # write offsets continue from.
+        P = max(item[2] for item in admitted)
+        rows = [ids for _, ids, _ in admitted]
+        reqs = [r for r, _, _ in admitted]
+        slots = []
+        for req, ids, _ in admitted:
+            slot = self.pool.alloc(SlotState(
+                request=req, base=P, real_len=min(len(ids), P),
+            ))
+            assert slot is not None  # admission is free-count bounded
+            slots.append(slot)
+        nb = _bucket_pow2(len(admitted), max(self.serving.prefill_group,
+                                             len(admitted)))
+        pad_id = tok.pad_id
+        tb = _left_pad(rows, pad_id, max_len=P)
+        tokens = np.full((nb, P), pad_id, np.int32)
+        valid = np.zeros((nb, P), bool)
+        tokens[: len(admitted)] = tb.tokens
+        valid[: len(admitted)] = tb.valid
+        # Batch-bucket pad rows: one valid token so softmax has mass
+        # (engine idiom); their slot id is out of range -> scatter-drop.
+        valid[len(admitted):, -1] = True
+        slot_ids = np.full((nb,), self.num_slots, np.int32)
+        slot_ids[: len(admitted)] = slots
+        fn = self._prefill_fn(nb, P)
+        try:
+            self._cache, self._prev_logits = fn(
+                self.engine.params, self._cache, self._prev_logits,
+                jnp.asarray(tokens), jnp.asarray(valid),
+                jnp.asarray(slot_ids),
+            )
+        except Exception as e:  # noqa: BLE001 — containment is the point
+            logger.warning("prefill batch (%d, %d) failed: %s", nb, P, e)
+            for slot, req in zip(slots, reqs):
+                self.pool.release(slot)
+                self._requeue_or_fail(req, f"prefill failed: {e}", stats)
+            return True
+        stats.prefill_batches += 1
+        stats.prefill_tokens += int(tb.lengths.sum())
+        stats.admitted += len(admitted)
+        return True
+
+    def _decode(self, stats: ServingStats) -> bool:
+        """One compiled decode chunk over the live slots; evict finished
+        rows. Returns True when any decoding happened."""
+        if self.fault_injector is not None:
+            for slot in self.pool.live_slots():
+                req = self.pool.get(slot).request
+                try:
+                    self.fault_injector.maybe_fail(req.id, "decode")
+                except DecodeFault as e:
+                    self.pool.release(slot)
+                    self._requeue_or_fail(req, str(e), stats)
+        live_ids = self.pool.live_slots()
+        if not live_ids:
+            return False
+        # Released-slot invalidation rides on the step program's reset mask
+        # (no separate dispatch). Slots released and REUSED before this
+        # point never enter the mask — SlotPool.alloc cancels their pending
+        # invalidation because prefill re-initialized the row.
+        reset = np.zeros((self.num_slots,), bool)
+        reset[self.pool.take_invalidations()] = True
+
+        B = self.num_slots
+        live = np.zeros((B,), bool)
+        emitted = np.zeros((B,), np.int32)
+        base = np.zeros((B,), np.int32)
+        caps = np.ones((B,), np.int32)
+        seeds = np.zeros((B,), np.uint32)
+        for slot in live_ids:
+            st = self.pool.get(slot)
+            live[slot] = True
+            emitted[slot] = st.emitted
+            base[slot] = st.base
+            caps[slot] = self._cap_for(st.request)
+            seed = st.request.row_seed
+            seeds[slot] = np.uint32((0 if seed is None else seed) & 0xFFFFFFFF)
+        fn = self._step_fn()
+        try:
+            self._cache, self._prev_logits, toks, emitted_after, counters = fn(
+                self.engine.params, self._cache, self._prev_logits,
+                jnp.asarray(seeds), jnp.asarray(emitted), jnp.asarray(base),
+                jnp.asarray(caps), jnp.asarray(live), jnp.asarray(reset),
+            )
+            toks = np.asarray(jax.device_get(toks))
+            emitted_after = np.asarray(jax.device_get(emitted_after))
+            counters = np.asarray(jax.device_get(counters))
+        except Exception as e:  # noqa: BLE001 — containment is the point
+            logger.warning("decode chunk failed: %s", e)
+            for slot in live_ids:
+                req = self.pool.release(slot).request
+                self._requeue_or_fail(req, f"decode failed: {e}", stats)
+            # Every live slot was just released, so nothing in the cache is
+            # still needed — rebuild device state from scratch (with TPU
+            # buffer donation, a raised call may have consumed the inputs).
+            self._cache = init_cache(
+                self.engine.config, self.num_slots, self.cache_len
+            )
+            self._prev_logits = jnp.zeros_like(self._prev_logits)
+            self.pool.take_invalidations()
+            return True
+        stats.decode_steps += int(counters[0])
+        stats.occupancy_sum += int(counters[1])
+        now = time.monotonic()
+        for slot in live_ids:
+            st = self.pool.get(slot)
+            n = int(emitted_after[slot]) - st.emitted
+            new = [int(t) for t in toks[slot, :n]]
+            st.tokens.extend(new)
+            st.emitted += n
+            stats.decoded_tokens += n
+            eos = self.engine.tokenizer.eos_id in new
+            if eos:
+                self._finish(slot, "eos", stats)
+            elif st.emitted >= self._cap_for(st.request):
+                self._finish(slot, "length", stats)
+            elif st.request.expired(now):
+                self._finish(slot, "deadline", stats)
+        return True
+
+    def _iterate(self, stats: ServingStats) -> bool:
+        stats.loop_iterations += 1
+        depth = len(self.queue)
+        stats.queue_depth_sum += depth
+        stats.queue_depth_max = max(stats.queue_depth_max, depth)
+        now = time.monotonic()
+        progressed = False
+        for req in self.queue.drain_expired(now):
+            self._fail(req, "deadline", "deadline expired in queue", stats)
+            progressed = True
+        for slot in self.pool.live_slots():
+            if self.pool.get(slot).request.expired(now):
+                self._finish(slot, "deadline", stats)
+                progressed = True
+        progressed |= self._admit(stats)
+        progressed |= self._decode(stats)
+        return progressed

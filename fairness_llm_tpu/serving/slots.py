@@ -1,0 +1,96 @@
+"""Fixed-size KV slot pool: the host-side allocator behind continuous batching.
+
+The device holds ONE persistent cache of ``num_slots`` rows (allocated once,
+shaped [num_slots, cache_len] per layer — see ``scheduler.py``); this module
+tracks which rows are live, what request occupies each, and the per-slot
+layout the decode step needs:
+
+- ``base``: the prompt bucket the row was PREFILLED at (its admission
+  batch's max bucket) — decode step t writes its KV at slot
+  ``base + emitted`` (the engine's per-row ``write_offsets`` machinery from
+  the speculative-decoding PR, promoted to the serving path)
+- ``real_len``: real (non-pad) prompt tokens — RoPE/learned positions
+  continue from here, exactly as a batch-1 ``DecodeEngine.generate`` would
+- ``emitted``: generated tokens so far (incl. a stopping EOS)
+
+Free slots form an explicit free list (lowest id first, deterministic);
+``release`` returns the slot and marks it for device-side invalidation —
+the scheduler zeroes the row's ``key_valid``/``lengths`` before the next
+decode step, so a recycled slot can never attend to its previous tenant's
+keys even transiently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Optional
+
+from fairness_llm_tpu.serving.request import Request
+
+
+@dataclasses.dataclass
+class SlotState:
+    request: Request
+    base: int  # bucketed prompt length = first decode write offset
+    real_len: int  # real prompt tokens (position origin for decode)
+    emitted: int = 0  # generated tokens so far
+    tokens: List[int] = dataclasses.field(default_factory=list)
+
+
+class SlotPool:
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._free: List[int] = list(range(num_slots))
+        heapq.heapify(self._free)
+        self._live: Dict[int, SlotState] = {}
+        # Slots released since the last invalidation flush; the scheduler
+        # zeroes their device rows (key_valid/lengths) and clears this.
+        self.pending_invalidation: List[int] = []
+
+    def __len__(self) -> int:
+        return len(self._live)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> int:
+        return len(self._live)
+
+    def live_slots(self) -> List[int]:
+        return sorted(self._live)
+
+    def get(self, slot: int) -> SlotState:
+        return self._live[slot]
+
+    def alloc(self, state: SlotState) -> Optional[int]:
+        """Claim the lowest free slot for ``state``; None when the pool is
+        full (the request stays queued — admission backpressure)."""
+        if not self._free:
+            return None
+        slot = heapq.heappop(self._free)
+        self._live[slot] = state
+        # A reallocated slot must NOT keep a deferred invalidation: prefill
+        # fully re-initializes the row ([0, P) overwritten, [P:) key_valid
+        # cleared), and a flush landing AFTER that prefill would wipe the
+        # new tenant's prompt (caught by the recycled-slot parity test).
+        if slot in self.pending_invalidation:
+            self.pending_invalidation.remove(slot)
+        return slot
+
+    def release(self, slot: int) -> SlotState:
+        """Free ``slot`` and queue it for device-side invalidation. Raises
+        KeyError for a slot that isn't live (double-release is a bug, not a
+        no-op — silent tolerance would mask allocator corruption)."""
+        state = self._live.pop(slot)
+        heapq.heappush(self._free, slot)
+        self.pending_invalidation.append(slot)
+        return state
+
+    def take_invalidations(self) -> List[int]:
+        out, self.pending_invalidation = self.pending_invalidation, []
+        return out

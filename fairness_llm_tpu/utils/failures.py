@@ -13,9 +13,43 @@ visible in the results.
 from __future__ import annotations
 
 import logging
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
+
+
+class DecodeFault(RuntimeError):
+    """A (possibly injected) per-request decode failure.
+
+    The continuous-batching scheduler (``serving/scheduler.py``) treats this
+    as a SLOT-level event, not a process-level one: the hit request is
+    requeued once (fresh prefill, fresh slot) and, if it faults again,
+    surfaced as a failed ``Result`` — the step loop itself never dies."""
+
+
+class ScriptedFaultInjector:
+    """Deterministic fault injection for serving tests and chaos drills.
+
+    ``faults`` maps ``(request_id, stage)`` — or plain ``request_id`` for any
+    stage — to the number of times that request should fault. Stages are
+    ``"prefill"`` and ``"decode"``. Each ``maybe_fail`` hit decrements the
+    budget, so "fail once then succeed" is ``{rid: 1}`` and "fail
+    permanently" is ``{rid: 2}`` (the scheduler requeues exactly once).
+    """
+
+    def __init__(self, faults: Dict[object, int]):
+        self._budget = dict(faults)
+        self.fired: List[tuple] = []  # (request_id, stage) audit log
+
+    def maybe_fail(self, request_id: str, stage: str) -> None:
+        for key in ((request_id, stage), request_id):
+            n = self._budget.get(key, 0)
+            if n > 0:
+                self._budget[key] = n - 1
+                self.fired.append((request_id, stage))
+                raise DecodeFault(
+                    f"injected {stage} fault for request {request_id!r}"
+                )
 
 
 def with_failure_containment(

@@ -238,6 +238,83 @@ class SpeculationStats:
         }
 
 
+@dataclasses.dataclass
+class ServingStats:
+    """Continuous-batching serving counters for one ``ContinuousScheduler``
+    drain (or a whole sweep, via ``merge``) — the observability half of
+    ``serving/``. Surfaced in ``GenerateOutput``-style stats by
+    ``serving.backend.ServingBackend`` (``serve_totals``), and recorded in
+    phase result metadata exactly like ``SpeculationStats`` above.
+
+    - ``admitted``: requests admitted into KV slots (a requeued request
+      counts again on its second admission)
+    - ``completed`` / ``failed`` / ``expired``: terminal request outcomes
+      (``expired`` = deadline passed before completion)
+    - ``rejected``: submissions refused at the queue (capacity/rate)
+    - ``requeued``: fault-hit slots sent back for one retry
+    - ``prefill_batches`` / ``prefill_tokens``: compiled prefill forwards and
+      the REAL prompt tokens they processed
+    - ``decode_steps`` / ``decoded_tokens``: compiled decode-step forwards
+      and real tokens emitted; tokens/step measures how full the slot pool
+      ran (max = ``num_slots``)
+    - ``occupancy_sum``: live slots summed over decode steps (avg occupancy
+      = occupancy_sum / decode_steps)
+    - ``queue_depth_sum`` / ``queue_depth_max`` / ``loop_iterations``:
+      admission-queue pressure over the scheduler loop
+    """
+
+    num_slots: int = 0
+    admitted: int = 0
+    completed: int = 0
+    failed: int = 0
+    expired: int = 0
+    rejected: int = 0
+    requeued: int = 0
+    prefill_batches: int = 0
+    prefill_tokens: int = 0
+    decode_steps: int = 0
+    decoded_tokens: int = 0
+    occupancy_sum: int = 0
+    queue_depth_sum: int = 0
+    queue_depth_max: int = 0
+    loop_iterations: int = 0
+
+    @property
+    def tokens_per_step(self) -> float:
+        return self.decoded_tokens / self.decode_steps if self.decode_steps else 0.0
+
+    @property
+    def avg_occupancy(self) -> float:
+        return self.occupancy_sum / self.decode_steps if self.decode_steps else 0.0
+
+    @property
+    def avg_queue_depth(self) -> float:
+        return (
+            self.queue_depth_sum / self.loop_iterations
+            if self.loop_iterations else 0.0
+        )
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServingStats":
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+    def merge(self, other: "ServingStats") -> "ServingStats":
+        summed = {
+            f.name: getattr(self, f.name) + getattr(other, f.name)
+            for f in dataclasses.fields(self)
+        }
+        summed["num_slots"] = other.num_slots or self.num_slots
+        summed["queue_depth_max"] = max(self.queue_depth_max, other.queue_depth_max)
+        return ServingStats(**summed)
+
+    def as_dict(self) -> dict:
+        out = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        out["tokens_per_step"] = round(self.tokens_per_step, 3)
+        out["avg_occupancy"] = round(self.avg_occupancy, 3)
+        out["avg_queue_depth"] = round(self.avg_queue_depth, 3)
+        return out
+
+
 @contextlib.contextmanager
 def phase_timer(name: str, sink: Optional[dict] = None) -> Iterator[None]:
     """Wall-clock phase timing (the reference's orchestrator pattern), with an

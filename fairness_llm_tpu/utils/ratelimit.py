@@ -1,11 +1,18 @@
 """Sliding-window rate limiter (reference ``utils.RateLimiter``,
 ``utils.py:386-408``).
 
-On-device decode has no quota, so the pipeline never uses this — it exists
-for users who point a ``DecodeBackend`` at an external rate-limited service
-(the reference's whole inference layer was such a service). Semantics match
-the reference: at most ``calls_per_minute`` calls in any trailing 60 s
-window, sleeping until the oldest call ages out.
+Two acquisition styles over one trailing-window ledger:
+
+- ``wait_if_needed()`` — the reference's blocking path (sleep until the
+  oldest call ages out), for callers pointing a ``DecodeBackend`` at an
+  external rate-limited service.
+- ``try_acquire()`` — non-blocking: admit-or-reject without sleeping. The
+  continuous-batching server (``serving/queue.py``) uses this for queue
+  admission, where blocking the scheduler's step loop on a quota would
+  stall every running request to slow down one new one.
+
+Semantics match the reference: at most ``calls_per_minute`` calls in any
+trailing ``window_seconds`` window.
 """
 
 from __future__ import annotations
@@ -20,6 +27,18 @@ class RateLimiter:
         self.calls_per_minute = calls_per_minute
         self.window = window_seconds
         self._times: Deque[float] = deque()
+
+    def try_acquire(self) -> bool:
+        """Non-blocking admit: True (and the call is recorded) when the
+        trailing window has room, False (nothing recorded) when it doesn't.
+        Never sleeps; ``wait_if_needed`` semantics are unchanged."""
+        now = time.monotonic()
+        while self._times and now - self._times[0] >= self.window:
+            self._times.popleft()
+        if len(self._times) >= self.calls_per_minute:
+            return False
+        self._times.append(now)
+        return True
 
     def wait_if_needed(self) -> float:
         """Block until a call is allowed; returns seconds slept."""
