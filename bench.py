@@ -232,7 +232,12 @@ def measure_continuous(engine, prompts, settings_cls) -> dict | None:
             int(np.sum(np.asarray(r.tokens) != pad_id))
             for r in results if r.ok
         )
-        return wall, useful, [r.latency_s for r in results], sched.last_stats
+        # TTFT per request from the scheduler's lifecycle spans
+        # (telemetry/tracing.py): first-token materialization relative to
+        # submission, chunk-granular — the client-visible number.
+        ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+        return wall, useful, [r.latency_s for r in results], ttfts, \
+            sched.last_stats
 
     run_static()  # warmup: compile every static chunk shape
     run_continuous()  # warmup: compile prefill buckets + the step program
@@ -241,14 +246,16 @@ def measure_continuous(engine, prompts, settings_cls) -> dict | None:
     st_wall, st_tok, st_lat = min(
         (run_static() for _ in range(2)), key=lambda r: r[0]
     )
-    ct_wall, ct_tok, ct_lat, ct_stats = min(
+    ct_wall, ct_tok, ct_lat, ct_ttft, ct_stats = min(
         (run_continuous() for _ in range(2)), key=lambda r: r[0]
     )
 
-    def pcts(lat):
+    def pcts(lat, prefix=""):
+        if not lat:
+            return {}
         return {
-            "p50_s": round(float(np.percentile(lat, 50)), 3),
-            "p95_s": round(float(np.percentile(lat, 95)), 3),
+            f"{prefix}p50_s": round(float(np.percentile(lat, 50)), 3),
+            f"{prefix}p95_s": round(float(np.percentile(lat, 95)), 3),
         }
 
     st_rate, ct_rate = st_tok / st_wall, ct_tok / ct_wall
@@ -264,6 +271,10 @@ def measure_continuous(engine, prompts, settings_cls) -> dict | None:
         "continuous": {
             "wall_s": round(ct_wall, 3), "useful_tokens": ct_tok,
             "tokens_per_sec": round(ct_rate, 1), **pcts(ct_lat),
+            # per-request TTFT (lifecycle spans) next to the e2e latency the
+            # static side can't decompose — chunk-granular, see
+            # telemetry/tracing.py
+            **pcts(ct_ttft, prefix="ttft_"),
             "serving_stats": ct_stats.as_dict() if ct_stats else None,
         },
         "speedup_tokens_per_sec": round(ct_rate / st_rate, 3),

@@ -16,6 +16,8 @@ runs the same detect -> cross-model-eval -> mitigate pipeline entirely on device
 - ``training/`` — sharded LM training step (loss + optax update) for fine-tuning
 - ``cli/``      — ``main.py``-equivalent front end (``--all/--phase/--quick``)
 - ``reports/``  — summary printers and figures
+- ``telemetry/`` — metrics registry, request-lifecycle tracing, exporters
+                  (``--telemetry-dir``; see docs/OBSERVABILITY.md)
 """
 
 __version__ = "0.1.0"

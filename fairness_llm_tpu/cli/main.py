@@ -9,6 +9,8 @@ Run examples:
     python -m fairness_llm_tpu.cli.main --all --quick
     python -m fairness_llm_tpu.cli.main --phase 1 --model llama3-8b --mesh dp=8
     python -m fairness_llm_tpu.cli.main --phase 3 --variant smart
+    python -m fairness_llm_tpu.cli.main --phase 1 --continuous --telemetry-dir tel/
+    python -m fairness_llm_tpu.cli.main telemetry-report tel/
 """
 
 from __future__ import annotations
@@ -143,6 +145,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true", help="resume phase-1 sweep from checkpoints")
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler device trace per phase to this directory")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="export telemetry here: streamed events.jsonl plus an "
+                        "end-of-run registry snapshot (telemetry_snapshot.json "
+                        "+ metrics.prom) with TTFT/queue-wait/latency "
+                        "histograms; render with `telemetry-report <dir>` "
+                        "(see docs/OBSERVABILITY.md)")
     p.add_argument("--no-save", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -165,6 +173,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["random_seed"] = args.seed
     if args.trace_dir:
         updates["profile_trace_dir"] = args.trace_dir
+    if args.telemetry_dir:
+        updates["telemetry_dir"] = args.telemetry_dir
     if args.max_new_tokens is not None:
         if args.max_new_tokens < 1:
             # A zero cap would reach the engine as a [B, 0] decode buffer and
@@ -204,7 +214,46 @@ def config_from_args(args: argparse.Namespace) -> Config:
     return config
 
 
+def telemetry_report(argv) -> int:
+    """``cli telemetry-report <dir|snapshot.json>`` — render a telemetry
+    snapshot in the terminal (the ``summarize_trace`` of the metrics world:
+    no dashboards required). ``--validate`` also runs the schema /
+    percentile-consistency check and fails on problems (the CI smoke
+    step's gate)."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu telemetry-report",
+        description="Render (and optionally validate) a telemetry snapshot",
+    )
+    ap.add_argument("path", help="telemetry dir (uses telemetry_snapshot.json "
+                                 "inside) or a snapshot file")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check the snapshot; non-zero exit on problems")
+    a = ap.parse_args(argv)
+    from fairness_llm_tpu.telemetry import load_snapshot, render_report, validate_snapshot
+
+    snap = load_snapshot(a.path)
+    if a.validate:
+        # Validate BEFORE rendering: the renderer assumes a well-formed
+        # snapshot, and the user asked for diagnostics, not a traceback.
+        problems = validate_snapshot(snap)
+        if problems:
+            print("SNAPSHOT INVALID:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+    print(render_report(snap))
+    if a.validate:
+        print("\nsnapshot schema: OK")
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "telemetry-report":
+        # Subcommand dispatch ahead of the study parser (whose --all/--phase
+        # group is required and would reject it).
+        return telemetry_report(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -214,6 +263,11 @@ def main(argv=None) -> int:
     config = config_from_args(args)
     check_setup(config)
     save = not args.no_save
+    telemetry_sink = None
+    if config.telemetry_dir:
+        from fairness_llm_tpu import telemetry as T
+
+        telemetry_sink = T.configure(config.telemetry_dir)
 
     if args.quick:
         args.num_items = min(args.num_items, 10)
@@ -279,6 +333,22 @@ def main(argv=None) -> int:
                 print("\n" + summary.format())
         except Exception as e:  # noqa: BLE001 — diagnostics must not fail the run
             logger.warning("trace summary unavailable: %s", e)
+
+    if config.telemetry_dir:
+        # End-of-run snapshot (JSON + Prometheus text) and the terminal
+        # report — the telemetry sibling of the trace summary above.
+        from fairness_llm_tpu import telemetry as T
+
+        try:
+            path = T.write_snapshot(T.get_registry(), config.telemetry_dir)
+            print("\n" + T.render_report(T.snapshot(T.get_registry())))
+            print(f"\ntelemetry snapshot: {path}")
+        except Exception as e:  # noqa: BLE001 — diagnostics must not fail the run
+            logger.warning("telemetry snapshot unavailable: %s", e)
+        finally:
+            if telemetry_sink is not None:
+                T.install_event_sink(None)
+                telemetry_sink.close()
 
     print("\n" + "=" * 60)
     print("RUN COMPLETE")

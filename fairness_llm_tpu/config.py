@@ -162,6 +162,12 @@ class Config:
     weight_quant: Optional[str] = None
     checkpoint_every: int = 20  # profiles between sweep checkpoints (reference: 20)
     profile_trace_dir: Optional[str] = None  # jax.profiler trace output
+    # Telemetry exporters (telemetry/): when set, the run streams lifecycle
+    # events to <dir>/events.jsonl and writes a registry snapshot
+    # (telemetry_snapshot.json + metrics.prom) at exit; render it with
+    # `cli telemetry-report <dir>`. Instrumentation itself is always on —
+    # this knob only controls the on-disk exports. See docs/OBSERVABILITY.md.
+    telemetry_dir: Optional[str] = None
     # Prompt-lookup speculative decoding for greedy sweeps (off by default:
     # the stock study settings sample at temperature 0.7, where speculation
     # cannot apply — see SpeculationConfig).
@@ -200,6 +206,8 @@ def default_config() -> Config:
         kwargs["data_dir"] = os.environ["FAIRNESS_TPU_DATA_DIR"]
     if os.environ.get("FAIRNESS_TPU_SEED"):
         kwargs["random_seed"] = int(os.environ["FAIRNESS_TPU_SEED"])
+    if os.environ.get("FAIRNESS_TPU_TELEMETRY_DIR"):
+        kwargs["telemetry_dir"] = os.environ["FAIRNESS_TPU_TELEMETRY_DIR"]
     return Config(**kwargs)
 
 

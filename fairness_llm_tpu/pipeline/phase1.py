@@ -40,6 +40,7 @@ from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
 from fairness_llm_tpu.pipeline.parsing import canonicalize, parse_numbered_list
 from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+from fairness_llm_tpu.telemetry import Heartbeat, get_registry
 from fairness_llm_tpu.utils.progress import print_progress
 
 logger = logging.getLogger(__name__)
@@ -75,6 +76,10 @@ def decode_sweep(
     # un-newlined bar. Piped/driver runs keep the INFO lines and no bar.
     interactive = getattr(sys.stderr, "isatty", lambda: False)()
     last_drawn = -1
+    # Low-frequency liveness pulse for multi-hour sweeps (at most one INFO
+    # line + JSONL event per interval) — the per-chunk lines above scroll
+    # away or drop to DEBUG; this one is for "is it still moving".
+    heartbeat = Heartbeat(interval_s=30.0, name=phase)
     # Chunk over ABSOLUTE positions in the full prompt list (not the remaining
     # todo list) so each chunk's decode seed is identical whether or not the
     # run was resumed mid-sweep — resume must not change sampling.
@@ -111,6 +116,7 @@ def decode_sweep(
             last_drawn = completed
         else:
             logger.info("%s sweep: %d/%d decoded", phase, completed, len(keys))
+        heartbeat.poke(completed=completed, total=len(keys))
     if 0 <= last_drawn < len(keys):
         # A resume whose tail chunks were all cached leaves the bar mid-line;
         # finish it so subsequent stderr output starts on a fresh line.
@@ -265,6 +271,16 @@ def run_phase1(
     snsr_age, snsv_age, sns_sims_age = M.snsr_snsv(neutral_flat, recs_by_age_flat)
 
     elapsed = time.time() - t0
+    # Phase-level telemetry (component="phase1"): wall-time distribution
+    # across runs of this process plus decode-failure visibility; the
+    # results-dict metadata below stays the durable record.
+    reg = get_registry()
+    reg.histogram("phase_wall_s", component="phase1").observe(elapsed)
+    reg.counter("phase_runs_total", component="phase1").inc()
+    reg.counter("profiles_decoded_total", component="phase1").inc(len(recs))
+    reg.counter("decode_failures_total", component="phase1").inc(
+        sum(1 for r in recs.values() if "error" in r)
+    )
     results = {
         "metadata": {
             "phase": 1,

@@ -423,6 +423,12 @@ def run_phase2(
             model_results[name]["serving"] = serve_totals.as_dict()
 
     comparison = compare_models_and_methods(model_results)
+    from fairness_llm_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.histogram("phase_wall_s", component="phase2").observe(time.time() - t0)
+    reg.counter("phase_runs_total", component="phase2").inc()
+    reg.counter("models_evaluated_total", component="phase2").inc(len(models))
     results = {
         "metadata": {
             "phase": 2,

@@ -354,6 +354,15 @@ def run_phase3(
         mit_by_gender[gender_of.get(pid, "")].append(lst)
     blended = blended_group_fairness(dict(mit_by_gender))
 
+    from fairness_llm_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    reg.histogram("phase_wall_s", component="phase3").observe(time.time() - t0)
+    reg.counter("phase_runs_total", component="phase3").inc()
+    reg.counter("profiles_mitigated_total", component="phase3").inc(
+        len(mitigated)
+    )
+
     results = {
         "metadata": {
             "phase": 3,

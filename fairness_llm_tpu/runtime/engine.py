@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -52,6 +53,7 @@ from fairness_llm_tpu.runtime.sampling import (
     speculation_applicable,
 )
 from fairness_llm_tpu.runtime.speculative import ngram_draft
+from fairness_llm_tpu.telemetry import get_registry
 from fairness_llm_tpu.utils.profiling import SpeculationStats
 
 logger = logging.getLogger(__name__)
@@ -534,6 +536,7 @@ class DecodeEngine:
                 f"max_new_tokens {max_new} >= model max_seq_len {self.config.max_seq_len}"
             )
         prompt_budget = self.config.max_seq_len - max_new
+        t_start = time.perf_counter()
         n = len(prompts)
         if n == 0:
             # An empty chunk (e.g. a fully-resumed sweep) must not compile and
@@ -755,6 +758,27 @@ class DecodeEngine:
                     break
                 ids.append(int(t))
             texts.append(self.tokenizer.decode(ids))
+        # Engine-path telemetry (component="engine"): call/token counters and
+        # the per-call wall histogram. Wall time here includes any compile —
+        # warmed steady-state calls dominate a sweep, and the histogram's
+        # max/percentile spread is exactly how a cold compile shows up.
+        reg = get_registry()
+        reg.counter("generate_calls_total", component="engine").inc()
+        reg.counter("prompt_tokens_total", component="engine").inc(
+            int(sum(len(r) for r in rows))
+        )
+        reg.counter("decoded_tokens_total", component="engine").inc(
+            int(np.sum(out != self.tokenizer.pad_id))
+        )
+        reg.counter(
+            "decode_paths_total", component="engine",
+            path="speculative" if use_spec else "plain",
+        ).inc()
+        reg.histogram("generate_wall_s", component="engine").observe(
+            time.perf_counter() - t_start
+        )
+        if spec_stats is not None:
+            spec_stats.publish(reg)
         stats: Dict[str, Any] = {
             "batch": batch,
             "prompt_len": prompt_len,

@@ -74,6 +74,12 @@ class Result:
     ``tokens`` matches the engine's per-row convention: generated ids
     including the EOS that stopped the row (when one did), nothing after.
     ``finish_reason``: "eos" | "length" | "failed" | "deadline".
+
+    ``queue_wait_s`` / ``ttft_s`` come from the request's lifecycle spans
+    (``telemetry/tracing.py``): admission wait and time-to-first-token, both
+    measured from the ``submitted_at`` stamp. None when the lifecycle never
+    reached the corresponding event (e.g. no TTFT for a request that
+    expired in the queue). ``latency_s`` remains the e2e wall.
     """
 
     id: str
@@ -87,3 +93,5 @@ class Result:
     prompt_tokens: int = 0
     latency_s: float = 0.0
     retries: int = 0
+    queue_wait_s: Optional[float] = None
+    ttft_s: Optional[float] = None

@@ -61,6 +61,7 @@ from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
 from fairness_llm_tpu.serving.queue import AdmissionQueue
 from fairness_llm_tpu.serving.request import Request, Result
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
+from fairness_llm_tpu.telemetry import Heartbeat, RequestTracer, get_registry
 from fairness_llm_tpu.utils.failures import DecodeFault
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
@@ -160,6 +161,12 @@ class ContinuousScheduler:
         # amortize per-call dispatch overhead; smaller chunks backfill
         # freed slots sooner.
         self.decode_chunk = max(1, self.serving.decode_chunk)
+        # Request-lifecycle tracing (telemetry/tracing.py): every request's
+        # submitted -> admitted -> prefill_start -> first_token -> terminal
+        # timeline, feeding the queue-wait/TTFT/per-token/e2e histograms in
+        # the process registry. Always on — host-side timestamps only.
+        self.tracer = RequestTracer(component="serving")
+        self._heartbeat = Heartbeat(interval_s=30.0, name="serving")
 
     # -- compiled programs --------------------------------------------------
 
@@ -333,7 +340,13 @@ class ContinuousScheduler:
         object built ahead of time doesn't age before the server sees it."""
         self._check_settings(request)
         request.submitted_at = time.monotonic()
-        return self.queue.submit(request)
+        accepted = self.queue.submit(request)
+        if accepted:
+            # Rejections are NOT recorded here: queue.rejected already counts
+            # them and the next drain publishes the delta as
+            # serving_rejected_total — one source of truth.
+            self.tracer.record(request.id, "submitted", t=request.submitted_at)
+        return accepted
 
     def take_result(self, request_id: str) -> Optional[Result]:
         """Claim (and remove) the Result of a request that terminated in an
@@ -370,7 +383,12 @@ class ContinuousScheduler:
             raise ValueError(f"duplicate request ids in serve() batch: {dup}")
         for r in requests:
             self._check_settings(r)
+        # Spans only after the WHOLE batch validated: a mid-batch
+        # _check_settings raise must not leave earlier requests' events
+        # stranded in the tracer (they would never finalize).
+        for r in requests:
             r.submitted_at = now
+            self.tracer.record(r.id, "submitted", t=now)
         self._pending = deque(requests)
         self._run_loop(stats)
         self.last_stats = stats
@@ -383,6 +401,10 @@ class ContinuousScheduler:
         while self._pending or len(self.queue) or self.pool.occupancy:
             progressed = self._iterate(stats)
             self._feed(stats)
+            self._heartbeat.poke(
+                occupancy=self.pool.occupancy, queue_depth=len(self.queue),
+                completed=stats.completed, decoded_tokens=stats.decoded_tokens,
+            )
             if not progressed and not self.pool.occupancy:
                 # Rate-limited admission with nothing decoding: yield briefly
                 # instead of spinning the loop dry.
@@ -392,6 +414,9 @@ class ContinuousScheduler:
         # single-threaded loop means none can occur during one).
         stats.rejected = self.queue.rejected - self._rejected_taken
         self._rejected_taken = self.queue.rejected
+        # One publish per drain: the registry accumulates process totals
+        # while this ServingStats object stays the per-drain record.
+        stats.publish()
 
     def _feed(self, stats: ServingStats) -> None:
         # Internal top-up from serve()'s pending overflow: a failed attempt
@@ -408,11 +433,16 @@ class ContinuousScheduler:
         tok = self.engine.tokenizer
         ids = list(tokens or [])
         text = tok.decode([t for t in ids if t != tok.eos_id])
+        row = self.tracer.finalize(
+            request.id, "expired" if reason == "deadline" else "failed",
+            tokens=len(ids),
+        )
         self._results[request.id] = Result(
             id=request.id, ok=False, text=text,
             tokens=np.asarray(ids, np.int32), finish_reason=reason,
             error=error, retries=request.retries,
             latency_s=time.monotonic() - request.submitted_at,
+            queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
         )
         if reason == "deadline":
             stats.expired += 1
@@ -420,10 +450,19 @@ class ContinuousScheduler:
             stats.failed += 1
 
     def _requeue_or_fail(self, request: Request, error: str,
-                         stats: ServingStats) -> None:
+                         stats: ServingStats, cause: str = "device") -> None:
         if request.retries < 1:
             request.retries += 1
             stats.requeued += 1
+            # Cause breakdown ("injected" = ScriptedFaultInjector chaos
+            # drills, "device" = a real raised prefill/decode) — the bare
+            # ServingStats.requeued total can't tell a drill from an
+            # incident; the registry label can.
+            get_registry().counter(
+                "serving_requeues_by_cause_total", component="serving",
+                cause=cause,
+            ).inc()
+            self.tracer.record(request.id, "requeued")
             self.queue.requeue(request)
         else:
             self._fail(request, "failed", error, stats)
@@ -442,11 +481,13 @@ class ContinuousScheduler:
             self._fail(req, "deadline", "deadline expired mid-decode",
                        stats, tokens=ids)
             return
+        row = self.tracer.finalize(req.id, "completed", tokens=len(ids))
         self._results[req.id] = Result(
             id=req.id, ok=True, text=text,
             tokens=np.asarray(ids, np.int32), finish_reason=reason,
             prompt_tokens=state.real_len, retries=req.retries,
             latency_s=time.monotonic() - req.submitted_at,
+            queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
         )
         stats.completed += 1
 
@@ -479,7 +520,7 @@ class ContinuousScheduler:
                 try:
                     self.fault_injector.maybe_fail(req.id, "prefill")
                 except DecodeFault as e:
-                    self._requeue_or_fail(req, str(e), stats)
+                    self._requeue_or_fail(req, str(e), stats, cause="injected")
                     continue
             ids = tok.encode(req.prompt)
             if len(ids) > self.prompt_budget:
@@ -521,6 +562,7 @@ class ContinuousScheduler:
             ))
             assert slot is not None  # admission is free-count bounded
             slots.append(slot)
+            self.tracer.record(req.id, "admitted")
         nb = _bucket_pow2(len(admitted), max(self.serving.prefill_group,
                                              len(admitted)))
         pad_id = tok.pad_id
@@ -535,6 +577,9 @@ class ContinuousScheduler:
         slot_ids = np.full((nb,), self.num_slots, np.int32)
         slot_ids[: len(admitted)] = slots
         fn = self._prefill_fn(nb, P)
+        pf_t0 = time.monotonic()
+        for req in reqs:
+            self.tracer.record(req.id, "prefill_start", t=pf_t0)
         try:
             self._cache, self._prev_logits = fn(
                 self.engine.params, self._cache, self._prev_logits,
@@ -543,10 +588,17 @@ class ContinuousScheduler:
             )
         except Exception as e:  # noqa: BLE001 — containment is the point
             logger.warning("prefill batch (%d, %d) failed: %s", nb, P, e)
+            get_registry().counter(
+                "faults_total", component="serving", kind="device",
+                stage="prefill",
+            ).inc()
             for slot, req in zip(slots, reqs):
                 self.pool.release(slot)
                 self._requeue_or_fail(req, f"prefill failed: {e}", stats)
             return True
+        get_registry().histogram(
+            "prefill_wall_s", component="serving"
+        ).observe(time.monotonic() - pf_t0)
         stats.prefill_batches += 1
         stats.prefill_tokens += int(tb.lengths.sum())
         stats.admitted += len(admitted)
@@ -562,7 +614,7 @@ class ContinuousScheduler:
                     self.fault_injector.maybe_fail(req.id, "decode")
                 except DecodeFault as e:
                     self.pool.release(slot)
-                    self._requeue_or_fail(req, str(e), stats)
+                    self._requeue_or_fail(req, str(e), stats, cause="injected")
         live_ids = self.pool.live_slots()
         if not live_ids:
             return False
@@ -599,6 +651,10 @@ class ContinuousScheduler:
             counters = np.asarray(jax.device_get(counters))
         except Exception as e:  # noqa: BLE001 — containment is the point
             logger.warning("decode chunk failed: %s", e)
+            get_registry().counter(
+                "faults_total", component="serving", kind="device",
+                stage="decode",
+            ).inc()
             for slot in live_ids:
                 req = self.pool.release(slot).request
                 self._requeue_or_fail(req, f"decode failed: {e}", stats)
@@ -611,13 +667,26 @@ class ContinuousScheduler:
             self._prev_logits = jnp.zeros_like(self._prev_logits)
             self.pool.take_invalidations()
             return True
-        stats.decode_steps += int(counters[0])
+        steps = int(counters[0])
+        stats.decode_steps += steps
         stats.occupancy_sum += int(counters[1])
         now = time.monotonic()
+        # Per-chunk pool-pressure samples, weighted by the steps the chunk
+        # actually ran (the compiled loop may exit early): live rows at
+        # entry is the occupancy every one of those steps decoded at most.
+        self.tracer.sample_step_gauges(
+            occupancy=len(live_ids), queue_depth=len(self.queue),
+            decode_steps=steps,
+        )
         for slot in live_ids:
             st = self.pool.get(slot)
             n = int(emitted_after[slot]) - st.emitted
             new = [int(t) for t in toks[slot, :n]]
+            if st.emitted == 0 and n > 0:
+                # Earliest HOST-visible time for this row's first token: the
+                # end of the chunk that produced it (see telemetry/tracing.py
+                # on granularity).
+                self.tracer.record(st.request.id, "first_token", t=now)
             st.tokens.extend(new)
             st.emitted += n
             stats.decoded_tokens += n

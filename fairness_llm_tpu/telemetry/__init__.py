@@ -1,0 +1,130 @@
+"""Unified telemetry subsystem: metrics registry, request-lifecycle tracing,
+and exporters.
+
+Before this package the framework's observability was three disconnected
+mechanisms: wall-clock ``phase_timer`` logs, XProf device traces
+(``--trace-dir``), and per-feature counter dataclasses
+(``SpeculationStats``/``ServingStats``). None of them could answer the
+serving questions the ROADMAP's "as fast as the hardware allows" goal is
+judged on — TTFT and per-output-token latency DISTRIBUTIONS, queue-wait
+attribution, occupancy over time. This package is the shared substrate:
+
+- ``registry``  — process-wide counters/gauges/log-bucket histograms,
+  labeled by component (``engine``, ``serving``, ``phase1..3``);
+  percentiles derive from bucket counts (no sample retention).
+- ``tracing``   — per-request lifecycle spans in the serving scheduler
+  (submitted -> admitted -> prefill_start -> first_token -> terminal),
+  yielding queue-wait / TTFT / per-output-token / e2e histograms.
+- ``export``    — JSONL event sink, snapshot dump (JSON + Prometheus text),
+  schema validation, and the ``cli telemetry-report`` terminal renderer.
+- ``heartbeat`` — low-frequency liveness pulse for long sweeps.
+
+Instrumentation is always-on (host-side integer arithmetic, zero device
+cost); the EXPORTERS are opt-in via ``--telemetry-dir``. The pre-existing
+stats dataclasses remain the phase-metadata serialization format — they now
+``publish()`` into the registry, so both views agree by construction.
+
+See docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fairness_llm_tpu.telemetry.registry import (
+    Counter,
+    DEFAULT_COUNT_BOUNDS,
+    DEFAULT_LATENCY_BOUNDS,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+    use_registry,
+)
+from fairness_llm_tpu.telemetry.export import (
+    JsonlSink,
+    load_snapshot,
+    read_events,
+    render_report,
+    snapshot,
+    to_prometheus,
+    validate_snapshot,
+    write_snapshot,
+)
+from fairness_llm_tpu.telemetry.tracing import (
+    RequestTracer,
+    SpanEvent,
+    TraceSummaryRow,
+    assert_span_order,
+)
+from fairness_llm_tpu.telemetry.heartbeat import Heartbeat
+
+# -- process-wide event sink --------------------------------------------------
+# One sink per process, installed by the CLI when --telemetry-dir is set
+# (and by tests directly). emit_event is a no-op without one, so span
+# recording costs nothing in un-exported runs.
+
+_event_sink: Optional[JsonlSink] = None
+
+
+def install_event_sink(sink: Optional[JsonlSink]) -> Optional[JsonlSink]:
+    """Install (or, with None, remove) the process event sink; returns the
+    previous one so callers can restore it."""
+    global _event_sink
+    prev, _event_sink = _event_sink, sink
+    return prev
+
+
+def event_sink() -> Optional[JsonlSink]:
+    return _event_sink
+
+
+def emit_event(kind: str, **fields) -> None:
+    if _event_sink is not None:
+        _event_sink.emit(kind, **fields)
+
+
+def configure(telemetry_dir: str) -> JsonlSink:
+    """Stand up the exporters for a run: mkdir the telemetry dir and install
+    the JSONL event sink there. Snapshot writing stays explicit
+    (``write_snapshot`` at end of run) — a snapshot mid-run is valid too,
+    it just reflects less."""
+    import os
+
+    from fairness_llm_tpu.telemetry.export import EVENTS_FILENAME
+
+    os.makedirs(telemetry_dir, exist_ok=True)
+    sink = JsonlSink(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    install_event_sink(sink)
+    return sink
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_COUNT_BOUNDS",
+    "DEFAULT_LATENCY_BOUNDS",
+    "get_registry",
+    "set_registry",
+    "use_registry",
+    "RequestTracer",
+    "SpanEvent",
+    "TraceSummaryRow",
+    "assert_span_order",
+    "JsonlSink",
+    "Heartbeat",
+    "snapshot",
+    "write_snapshot",
+    "load_snapshot",
+    "validate_snapshot",
+    "to_prometheus",
+    "render_report",
+    "read_events",
+    "install_event_sink",
+    "event_sink",
+    "emit_event",
+    "configure",
+]

@@ -1,0 +1,52 @@
+"""Heartbeat logger for long sweeps.
+
+A multi-hour sweep (thousands of profiles, or a long serving drain) emits
+per-chunk DEBUG/INFO lines that scroll away; the heartbeat is the opposite:
+a LOW-frequency, high-signal pulse — at most one line per ``interval_s`` —
+carrying cumulative progress and the registry's live totals, plus a JSONL
+``heartbeat`` event when a sink is installed so liveness is reconstructable
+from the telemetry dir after the fact ("was it still making progress at
+02:13, and at what rate?").
+
+Passive by design: ``poke()`` is called from loops that already run on the
+host (``decode_sweep`` per chunk, the scheduler per iteration) and does
+nothing until the interval elapses. No background thread — a thread would
+outlive test processes and interleave with jax dispatch for zero benefit at
+a once-per-30s duty cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Heartbeat:
+    def __init__(self, interval_s: float = 30.0, name: str = "sweep"):
+        self.interval_s = interval_s
+        self.name = name
+        self.started_at = time.monotonic()
+        self._last_beat: Optional[float] = None
+        self.beats = 0
+
+    def poke(self, **fields) -> bool:
+        """Maybe emit one heartbeat; returns True when it fired. ``fields``
+        are caller progress (e.g. ``completed=32, total=45``) merged into
+        both the log line and the JSONL event."""
+        now = time.monotonic()
+        if self._last_beat is not None and now - self._last_beat < self.interval_s:
+            return False
+        self._last_beat = now
+        self.beats += 1
+        uptime = now - self.started_at
+        from fairness_llm_tpu.telemetry import emit_event, get_registry
+
+        get_registry().counter("heartbeats_total", component=self.name).inc()
+        info = " ".join(f"{k}={v}" for k, v in fields.items())
+        logger.info("heartbeat[%s] uptime=%.0fs %s", self.name, uptime, info)
+        emit_event("heartbeat", name=self.name, uptime_s=round(uptime, 1),
+                   **fields)
+        return True

@@ -47,6 +47,15 @@ class ScriptedFaultInjector:
             if n > 0:
                 self._budget[key] = n - 1
                 self.fired.append((request_id, stage))
+                # Injected faults are labeled apart from device-raised ones
+                # (the scheduler counts those kind="device") so a chaos
+                # drill's telemetry can't be mistaken for a real incident.
+                from fairness_llm_tpu.telemetry import get_registry
+
+                get_registry().counter(
+                    "faults_total", component="serving", kind="injected",
+                    stage=stage,
+                ).inc()
                 raise DecodeFault(
                     f"injected {stage} fault for request {request_id!r}"
                 )
@@ -77,6 +86,11 @@ def with_failure_containment(
                 ))
             except Exception as e:  # noqa: BLE001 — containment is the point
                 last = e
+                from fairness_llm_tpu.telemetry import get_registry
+
+                get_registry().counter(
+                    "contained_chunk_failures_total", component="pipeline"
+                ).inc()
                 logger.warning(
                     "decode chunk failed (attempt %d/%d): %s",
                     attempt + 1, retries + 1, e,

@@ -34,7 +34,11 @@ def maybe_trace(trace_dir: Optional[str], label: str = "region") -> Iterator[Non
     import jax
 
     logger.info("profiling %s -> %s", label, trace_dir)
-    with jax.profiler.trace(trace_dir):
+    # Annotate the traced region with its label: a multi-phase --all capture
+    # writes one timestamped directory per phase, but inside XProf the host
+    # planes were indistinguishable — the TraceAnnotation puts "phase1" /
+    # "phase2" / "phase3" spans on the trace-viewer timeline itself.
+    with jax.profiler.trace(trace_dir), jax.profiler.TraceAnnotation(label):
         yield
 
 
@@ -237,6 +241,22 @@ class SpeculationStats:
             "ngram_max": self.ngram_max,
         }
 
+    def publish(self, registry=None, component: str = "engine") -> None:
+        """Mirror this object's counters into the telemetry registry
+        (``telemetry/registry.py``), making the dataclass a registry-backed
+        view: the engine publishes each per-call stats object exactly once,
+        so registry totals equal the merged sweep totals while ``as_dict``
+        stays the byte-compatible phase-metadata format."""
+        from fairness_llm_tpu.telemetry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        reg.counter("spec_drafted_total", component=component).inc(self.drafted)
+        reg.counter("spec_accepted_total", component=component).inc(self.accepted)
+        reg.counter("spec_verify_steps_total", component=component).inc(
+            self.verify_steps
+        )
+        reg.counter("spec_emitted_total", component=component).inc(self.emitted)
+
 
 @dataclasses.dataclass
 class ServingStats:
@@ -313,6 +333,28 @@ class ServingStats:
         out["avg_occupancy"] = round(self.avg_occupancy, 3)
         out["avg_queue_depth"] = round(self.avg_queue_depth, 3)
         return out
+
+    def publish(self, registry=None, component: str = "serving") -> None:
+        """Mirror one drain's counters into the telemetry registry (same
+        contract as ``SpeculationStats.publish``: call once per drain so the
+        registry carries process totals). ``num_slots`` and
+        ``queue_depth_max`` are level/high-water quantities, not event
+        counts, so they publish as gauges."""
+        from fairness_llm_tpu.telemetry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        for name in (
+            "admitted", "completed", "failed", "expired", "rejected",
+            "requeued", "prefill_batches", "prefill_tokens", "decode_steps",
+            "decoded_tokens", "loop_iterations",
+        ):
+            reg.counter(f"serving_{name}_total", component=component).inc(
+                getattr(self, name)
+            )
+        reg.gauge("serving_num_slots", component=component).set(self.num_slots)
+        reg.gauge("serving_queue_depth_max", component=component).set_max(
+            self.queue_depth_max
+        )
 
 
 @contextlib.contextmanager

@@ -1,0 +1,517 @@
+"""Telemetry subsystem tests: registry math, exporters, lifecycle tracing.
+
+The load-bearing guarantees pinned here:
+
+- histogram percentiles derive from bucket counts alone, with exact edge
+  cases (empty, single-sample, boundary values) and the self-consistency
+  ordering p50 <= p95 <= p99 <= observed max;
+- registry label isolation (same name, different labels = independent
+  instruments) and kind-collision rejection;
+- ``SpeculationStats``/``ServingStats`` merge/as_dict/from_dict roundtrips
+  stay byte-compatible (they are the phase-metadata wire format) while
+  ``publish`` mirrors them into the registry;
+- a real scheduler drain produces ordered lifecycle spans with
+  TTFT <= e2e per request and nonzero TTFT/queue-wait/per-token histograms
+  — the ISSUE-3 acceptance shape.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import fairness_llm_tpu.telemetry as T
+from fairness_llm_tpu.telemetry import (
+    Heartbeat,
+    Histogram,
+    MetricsRegistry,
+    RequestTracer,
+    assert_span_order,
+    use_registry,
+)
+from fairness_llm_tpu.telemetry.tracing import TERMINAL_EVENTS
+from fairness_llm_tpu.utils.profiling import ServingStats, SpeculationStats
+
+
+# -- histogram math -----------------------------------------------------------
+
+
+def test_histogram_empty():
+    h = Histogram("x", {}, bounds=(1.0, 2.0, 4.0))
+    assert h.count == 0 and h.percentile(50) is None and h.mean is None
+    d = h.as_dict()
+    assert d["count"] == 0 and d["p50"] is None and d["max"] is None
+    assert sum(d["bucket_counts"]) == 0
+
+
+def test_histogram_single_sample_exact():
+    h = Histogram("x", {}, bounds=(1.0, 2.0, 4.0))
+    h.observe(1.3)
+    # The min/max clamp makes every percentile of a single sample exact,
+    # whatever bucket resolution says.
+    for q in (0, 50, 95, 99, 100):
+        assert h.percentile(q) == pytest.approx(1.3)
+    assert h.mean == pytest.approx(1.3)
+
+
+def test_histogram_boundary_values_le_semantics():
+    h = Histogram("x", {}, bounds=(1.0, 2.0, 4.0))
+    h.observe(2.0)  # exactly on a bound -> that bound's bucket (le)
+    assert h.bucket_counts == [0, 1, 0, 0]
+    h.observe(2.0000001)  # just past -> next bucket
+    assert h.bucket_counts == [0, 1, 1, 0]
+
+
+def test_histogram_underflow_overflow():
+    h = Histogram("x", {}, bounds=(1.0, 2.0, 4.0))
+    h.observe(0.25)   # below the first bound -> bucket 0
+    h.observe(100.0)  # above the last bound -> overflow bucket
+    assert h.bucket_counts == [1, 0, 0, 1]
+    assert h.percentile(0) == pytest.approx(0.25)   # clamped to observed min
+    assert h.percentile(100) == pytest.approx(100.0)  # overflow uses max
+
+
+def test_histogram_percentile_ordering_and_range():
+    rng = np.random.default_rng(0)
+    h = Histogram("x", {})
+    vals = rng.lognormal(mean=-3.0, sigma=2.0, size=500)
+    for v in vals:
+        h.observe(v)
+    ps = [h.percentile(q) for q in (1, 25, 50, 90, 95, 99, 100)]
+    assert ps == sorted(ps)
+    assert h.min <= ps[0] and ps[-1] <= h.max
+    # nearest-rank with upper-edge estimate is conservative: never below the
+    # true percentile's bucket lower edge, never above observed max
+    assert h.percentile(50) <= h.max
+
+
+def test_histogram_rejects_bad_args():
+    with pytest.raises(ValueError):
+        Histogram("x", {}, bounds=())
+    with pytest.raises(ValueError):
+        Histogram("x", {}, bounds=(2.0, 1.0))
+    h = Histogram("x", {}, bounds=(1.0,))
+    h.observe(1)
+    with pytest.raises(ValueError):
+        h.percentile(101)
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_registry_label_isolation_and_identity():
+    r = MetricsRegistry()
+    a = r.counter("requests_total", component="engine")
+    b = r.counter("requests_total", component="serving")
+    a.inc(3)
+    assert b.value == 0  # labels isolate
+    assert r.counter("requests_total", component="engine") is a  # get-or-create
+
+
+def test_registry_kind_collision_rejected():
+    r = MetricsRegistry()
+    r.counter("x", component="a")
+    with pytest.raises(ValueError):
+        r.histogram("x", component="a")
+    with pytest.raises(ValueError):
+        r.gauge("x", component="b")  # kind is per-name, not per-labelset
+
+
+def test_counter_monotonic_gauge_not():
+    r = MetricsRegistry()
+    c = r.counter("c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("g")
+    g.set(5)
+    g.set_max(3)
+    assert g.value == 5
+    g.set_max(9)
+    assert g.value == 9
+
+
+def test_use_registry_swaps_process_registry():
+    before = T.get_registry()
+    with use_registry() as reg:
+        assert T.get_registry() is reg
+        T.get_registry().counter("inside").inc()
+        assert reg.counter("inside").value == 1
+    assert T.get_registry() is before
+
+
+# -- exporters ----------------------------------------------------------------
+
+
+def _populated_registry():
+    r = MetricsRegistry()
+    r.counter("requests_total", component="serving").inc(7)
+    r.gauge("queue_depth", component="serving").set(2)
+    h = r.histogram("ttft_s", component="serving")
+    for v in (0.01, 0.02, 0.04, 0.9):
+        h.observe(v)
+    return r
+
+
+def test_snapshot_validates_and_renders():
+    snap = T.snapshot(_populated_registry())
+    assert T.validate_snapshot(snap) == []
+    text = T.render_report(snap)
+    assert "ttft_s" in text and "requests_total" in text and "[serving]" in text
+    # JSON-serializable end to end (the file format)
+    assert T.validate_snapshot(json.loads(json.dumps(snap))) == []
+
+
+def test_validate_snapshot_catches_corruption():
+    snap = T.snapshot(_populated_registry())
+    bad = json.loads(json.dumps(snap))
+    bad["histograms"][0]["p50"] = 99.0  # > p95: ordering violated
+    assert any("ordering" in p for p in T.validate_snapshot(bad))
+    bad2 = json.loads(json.dumps(snap))
+    bad2["histograms"][0]["bucket_counts"][0] += 1
+    assert any("sum" in p for p in T.validate_snapshot(bad2))
+    assert T.validate_snapshot({"nope": 1})  # missing sections
+
+
+def test_prometheus_exposition_cumulative_buckets():
+    r = _populated_registry()
+    text = T.to_prometheus(r)
+    assert 'fairness_llm_requests_total{component="serving"} 7' in text
+    # +Inf bucket equals total count; bucket lines are cumulative
+    assert 'le="+Inf"} 4' in text
+    assert "fairness_llm_ttft_s_count" in text
+    assert "# TYPE fairness_llm_ttft_s histogram" in text
+
+
+def test_write_and_load_snapshot_roundtrip(tmp_path):
+    r = _populated_registry()
+    path = T.write_snapshot(r, str(tmp_path))
+    assert os.path.basename(path) == "telemetry_snapshot.json"
+    assert os.path.exists(tmp_path / "metrics.prom")
+    snap = T.load_snapshot(str(tmp_path))  # dir form
+    assert T.validate_snapshot(snap) == []
+    assert snap["counters"][0]["value"] == 7
+
+
+def test_jsonl_sink_and_read_events(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    with T.JsonlSink(p) as sink:
+        sink.emit("span", request_id="r1", event="submitted", t=1.0)
+        sink.emit("heartbeat", uptime_s=5)
+    with open(p, "a") as f:
+        f.write('{"torn')  # a killed process can leave a torn last line
+    evs = T.read_events(p)
+    assert [e["kind"] for e in evs] == ["span", "heartbeat"]
+    assert evs[0]["request_id"] == "r1"
+
+
+def test_global_event_sink_install_and_emit(tmp_path):
+    p = str(tmp_path / "e.jsonl")
+    sink = T.JsonlSink(p)
+    prev = T.install_event_sink(sink)
+    try:
+        T.emit_event("test", a=1)
+    finally:
+        T.install_event_sink(prev)
+        sink.close()
+    assert T.read_events(p)[0]["a"] == 1
+    T.emit_event("dropped")  # no sink installed: silent no-op
+
+
+# -- tracer -------------------------------------------------------------------
+
+
+def test_tracer_derives_latency_decomposition():
+    with use_registry() as reg:
+        tr = RequestTracer(component="serving")
+        tr.record("r1", "submitted", t=10.0)
+        tr.record("r1", "admitted", t=10.5)
+        tr.record("r1", "prefill_start", t=10.6)
+        tr.record("r1", "first_token", t=11.0)
+        row = tr.finalize("r1", "completed", tokens=5)
+        assert row.queue_wait_s == pytest.approx(0.5)
+        assert row.ttft_s == pytest.approx(1.0)
+        assert row.e2e_s is not None and row.ttft_s <= row.e2e_s
+        assert row.per_output_token_s is not None
+        assert reg.histogram("ttft_s", component="serving").count == 1
+        assert reg.histogram("queue_wait_s", component="serving").count == 1
+        assert reg.counter("requests_finished_total", component="serving",
+                           outcome="completed").value == 1
+        assert reg.counter("output_tokens_total", component="serving").value == 5
+
+
+def test_tracer_partial_lifecycle_and_bad_outcome():
+    with use_registry() as reg:
+        tr = RequestTracer(component="serving")
+        tr.record("q", "submitted", t=1.0)
+        row = tr.finalize("q", "expired", tokens=0)  # expired in queue
+        assert row.queue_wait_s is None and row.ttft_s is None
+        assert row.e2e_s is not None
+        # single-token/zero-token requests have no steady-state cadence
+        assert row.per_output_token_s is None
+        assert reg.histogram("ttft_s", component="serving").count == 0
+        with pytest.raises(ValueError):
+            tr.finalize("other", "eaten_by_bear", tokens=0)
+
+
+def test_tracer_requeued_request_uses_delivered_first_token():
+    """A fault-requeued request's first attempt's tokens were discarded;
+    TTFT/cadence must describe the retry's stream (LAST first_token), while
+    queue-wait keeps the FIRST admission (initial backpressure)."""
+    with use_registry():
+        tr = RequestTracer(component="serving")
+        tr.record("r", "submitted", t=0.0)
+        tr.record("r", "admitted", t=1.0)
+        tr.record("r", "first_token", t=2.0)   # attempt 1, later discarded
+        tr.record("r", "requeued", t=3.0)
+        tr.record("r", "admitted", t=4.0)
+        tr.record("r", "first_token", t=5.0)   # the delivered stream
+        row = tr.finalize("r", "completed", tokens=3)
+        assert row.queue_wait_s == pytest.approx(1.0)
+        assert row.ttft_s == pytest.approx(5.0)
+
+
+def test_assert_span_order():
+    tr = RequestTracer(component="t")
+    with use_registry():
+        tr.record("a", "submitted", t=1.0)
+        tr.record("a", "admitted", t=2.0)
+        tr.finalize("a", "completed", tokens=1)
+        (row, events), = [tr.finished[-1]]
+        assert_span_order(events)
+    from fairness_llm_tpu.telemetry import SpanEvent
+
+    with pytest.raises(AssertionError):
+        assert_span_order([SpanEvent("a", "admitted", 1.0)])
+    with pytest.raises(AssertionError):
+        assert_span_order([SpanEvent("a", "submitted", 2.0),
+                           SpanEvent("a", "admitted", 1.0)])
+    with pytest.raises(AssertionError):
+        assert_span_order([SpanEvent("a", "submitted", 1.0),
+                           SpanEvent("a", "completed", 2.0),
+                           SpanEvent("a", "admitted", 3.0)])
+
+
+def test_heartbeat_rate_limited():
+    with use_registry() as reg:
+        hb = Heartbeat(interval_s=1000.0, name="t")
+        assert hb.poke(completed=1)      # first poke always fires
+        assert not hb.poke(completed=2)  # inside the interval: suppressed
+        assert reg.counter("heartbeats_total", component="t").value == 1
+        hb0 = Heartbeat(interval_s=0.0, name="t")
+        assert hb0.poke() and hb0.poke()  # zero interval: every poke fires
+
+
+# -- stats dataclass roundtrips + publish ------------------------------------
+
+
+def test_speculation_stats_roundtrip_and_publish():
+    a = SpeculationStats(drafted=10, accepted=4, verify_steps=3, emitted=7,
+                         draft_len=8, ngram_max=3)
+    d = a.as_dict()
+    # byte-compat contract: exactly the PR-1 key set, derived keys included
+    assert set(d) == {"drafted", "accepted", "verify_steps", "emitted",
+                      "acceptance_rate", "tokens_per_step", "draft_len",
+                      "ngram_max"}
+    rt = SpeculationStats.from_dict(d)
+    assert rt == a
+    m = a.merge(SpeculationStats(drafted=2, accepted=1, verify_steps=1,
+                                 emitted=2, draft_len=8, ngram_max=3))
+    assert m.drafted == 12 and m.accepted == 5
+    with use_registry() as reg:
+        a.publish()
+        assert reg.counter("spec_drafted_total", component="engine").value == 10
+        assert reg.counter("spec_accepted_total", component="engine").value == 4
+
+
+def test_serving_stats_roundtrip_and_publish():
+    a = ServingStats(num_slots=4, admitted=6, completed=5, failed=1,
+                     requeued=2, decode_steps=30, decoded_tokens=100,
+                     occupancy_sum=90, queue_depth_sum=12, queue_depth_max=5,
+                     loop_iterations=10)
+    d = a.as_dict()
+    rt = ServingStats.from_dict(d)
+    assert rt == a  # derived keys dropped on the way in
+    with use_registry() as reg:
+        a.publish()
+        assert reg.counter("serving_admitted_total",
+                           component="serving").value == 6
+        assert reg.counter("serving_decoded_tokens_total",
+                           component="serving").value == 100
+        assert reg.gauge("serving_num_slots", component="serving").value == 4
+        assert reg.gauge("serving_queue_depth_max",
+                         component="serving").value == 5
+        a.publish()  # second drain accumulates counters, gauges re-set
+        assert reg.counter("serving_admitted_total",
+                           component="serving").value == 12
+        assert reg.gauge("serving_num_slots", component="serving").value == 4
+
+
+# -- scheduler integration (the acceptance-criteria shape) -------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+def _greedy(m):
+    from fairness_llm_tpu.config import ModelSettings
+
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+def test_scheduler_drain_spans_and_histograms(engine, tmp_path):
+    from fairness_llm_tpu.config import ServingConfig
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+
+    sink = T.JsonlSink(str(tmp_path / "events.jsonl"))
+    prev = T.install_event_sink(sink)
+    try:
+        with use_registry() as reg:
+            sched = ContinuousScheduler(
+                engine,
+                ServingConfig(enabled=True, num_slots=2, max_prompt_len=64,
+                              max_new_tokens=16, decode_chunk=4),
+                settings=_greedy(16),
+            )
+            reqs = [Request(prompt=f"the number {i} is", id=f"t{i:02d}",
+                            settings=_greedy(4 + 2 * i)) for i in range(5)]
+            results = sched.serve(reqs)
+            assert all(r.ok for r in results)
+
+            # Per-request span ordering + TTFT <= e2e (from Result fields AND
+            # the tracer's retained traces).
+            rows = {row.request_id: (row, evs)
+                    for row, evs in sched.tracer.finished}
+            for r in results:
+                row, evs = rows[r.id]
+                assert_span_order(evs)
+                names = [e.event for e in evs]
+                assert names[0] == "submitted"
+                assert "admitted" in names and "first_token" in names
+                assert names.index("admitted") < names.index("first_token")
+                assert evs[-1].event == "completed"
+                assert r.ttft_s is not None and r.queue_wait_s is not None
+                assert 0 <= r.queue_wait_s <= r.ttft_s <= r.latency_s
+                assert row.ttft_s <= row.e2e_s
+
+            # The acceptance-criteria histograms: nonzero counts,
+            # self-consistent percentiles.
+            for name in ("ttft_s", "queue_wait_s", "per_output_token_s",
+                         "e2e_latency_s"):
+                h = reg.histogram(name, component="serving")
+                assert h.count > 0, name
+                p50, p95, p99 = (h.percentile(q) for q in (50, 95, 99))
+                assert p50 <= p95 <= p99 <= h.max, name
+            assert reg.histogram("ttft_s", component="serving").min > 0
+
+            # Pool-pressure samples: one weighted observation per decode step.
+            occ = reg.histogram("slot_occupancy_dist", component="serving")
+            stats = sched.last_stats
+            assert occ.count == stats.decode_steps > 0
+            # drain-level publish mirrored the dataclass into the registry
+            assert reg.counter("serving_completed_total",
+                               component="serving").value == stats.completed
+
+        # every span event also reached the JSONL sink
+        evs = T.read_events(str(tmp_path / "events.jsonl"))
+        spans = [e for e in evs if e["kind"] == "span"]
+        assert {e["event"] for e in spans} >= {"submitted", "admitted",
+                                               "prefill_start", "first_token",
+                                               "completed"}
+    finally:
+        T.install_event_sink(prev)
+        sink.close()
+
+
+def test_scheduler_fault_cause_breakdown(engine):
+    from fairness_llm_tpu.config import ServingConfig
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+    from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+    with use_registry() as reg:
+        sched = ContinuousScheduler(
+            engine,
+            ServingConfig(enabled=True, num_slots=2, max_prompt_len=64,
+                          max_new_tokens=8, decode_chunk=2),
+            settings=_greedy(8),
+            fault_injector=ScriptedFaultInjector({("flaky", "decode"): 1}),
+        )
+        res = sched.serve([Request(prompt="hello there", id="flaky",
+                                   settings=_greedy(4))])
+        assert res[0].ok and res[0].retries == 1
+        assert reg.counter("faults_total", component="serving",
+                           kind="injected", stage="decode").value == 1
+        assert reg.counter("serving_requeues_by_cause_total",
+                           component="serving", cause="injected").value == 1
+        # no device-raised faults in this run
+        assert reg.counter("faults_total", component="serving",
+                           kind="device", stage="decode").value == 0
+        # the requeued request's lifecycle records the requeue span
+        row, evs = next(t for t in sched.tracer.finished
+                        if t[0].request_id == "flaky")
+        assert "requeued" in [e.event for e in evs]
+        assert row.outcome == "completed"
+
+
+def test_engine_generate_instrumented(engine):
+    with use_registry() as reg:
+        out = engine.generate(["one two three"], _greedy(4), seed=0)
+        assert reg.counter("generate_calls_total", component="engine").value == 1
+        assert reg.counter("prompt_tokens_total", component="engine").value > 0
+        assert reg.counter("decoded_tokens_total", component="engine").value > 0
+        h = reg.histogram("generate_wall_s", component="engine")
+        assert h.count == 1 and h.max > 0
+        assert reg.counter("decode_paths_total", component="engine",
+                           path="plain").value == 1
+
+
+# -- CLI surface --------------------------------------------------------------
+
+
+def test_cli_telemetry_dir_and_report(tmp_path, capsys):
+    from fairness_llm_tpu.cli.main import main
+
+    tel = str(tmp_path / "tel")
+    with use_registry():
+        rc = main(["--phase", "1", "--quick", "--model", "simulated",
+                   "--results-dir", str(tmp_path / "res"), "--no-save",
+                   "--telemetry-dir", tel])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "TELEMETRY REPORT" in out and "telemetry snapshot:" in out
+    snap = T.load_snapshot(tel)
+    assert T.validate_snapshot(snap) == []
+    # phase-1 instrumentation landed in the snapshot
+    names = {(c["name"], c["labels"].get("component"))
+             for c in snap["counters"]}
+    assert ("phase_runs_total", "phase1") in names
+    assert os.path.exists(os.path.join(tel, "metrics.prom"))
+    # the heartbeat's first poke streams to events.jsonl
+    evs = T.read_events(os.path.join(tel, "events.jsonl"))
+    assert any(e["kind"] == "heartbeat" for e in evs)
+    # sink was uninstalled at end of run
+    assert T.event_sink() is None
+
+    rc = main(["telemetry-report", tel, "--validate"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "TELEMETRY REPORT" in out and "snapshot schema: OK" in out
+
+
+def test_cli_telemetry_report_rejects_invalid(tmp_path, capsys):
+    from fairness_llm_tpu.cli.main import main
+    from fairness_llm_tpu.telemetry.export import SNAPSHOT_FILENAME
+
+    snap = T.snapshot(_populated_registry())
+    snap["histograms"][0]["p50"] = 1e9  # break the ordering invariant
+    path = tmp_path / SNAPSHOT_FILENAME
+    path.write_text(json.dumps(snap))
+    rc = main(["telemetry-report", str(tmp_path), "--validate"])
+    assert rc == 1
+    assert "SNAPSHOT INVALID" in capsys.readouterr().out

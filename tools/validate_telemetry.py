@@ -1,0 +1,68 @@
+"""Validate a telemetry snapshot (schema + percentile self-consistency) and
+assert the serving signals the ISSUE-3 acceptance criteria name are present
+and nonzero.
+
+Usage:
+    python tools/validate_telemetry.py <telemetry-dir-or-snapshot.json>
+    python tools/validate_telemetry.py <path> --require-serving
+
+Plain mode checks the schema only (`cli telemetry-report --validate` does
+the same inline). ``--require-serving`` additionally requires nonzero TTFT,
+queue-wait, and per-output-token histograms with p50 <= p95 <= p99 <= max —
+the CI smoke step's gate after a ``--continuous --telemetry-dir`` run of the
+tiny CPU study.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fairness_llm_tpu.telemetry import load_snapshot, validate_snapshot  # noqa: E402
+
+REQUIRED_SERVING_HISTOGRAMS = ("ttft_s", "queue_wait_s", "per_output_token_s")
+
+
+def check(path: str, require_serving: bool = False) -> int:
+    snap = load_snapshot(path)
+    problems = list(validate_snapshot(snap))
+    if require_serving:
+        hists = {
+            h["name"]: h
+            for h in snap.get("histograms", [])
+            if h.get("labels", {}).get("component") == "serving"
+        }
+        for name in REQUIRED_SERVING_HISTOGRAMS:
+            h = hists.get(name)
+            if h is None:
+                problems.append(f"serving histogram {name!r} missing")
+            elif not h.get("count"):
+                problems.append(f"serving histogram {name!r} is empty")
+            elif not (h.get("min") or 0) > 0:
+                problems.append(f"serving histogram {name!r} has zero samples")
+        # validate_snapshot already enforced p50 <= p95 <= p99 <= max for
+        # every non-empty histogram; nothing extra to re-derive here.
+    if problems:
+        print(f"INVALID: {path}")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print(f"OK: {path} "
+          f"({len(snap.get('counters', []))} counters, "
+          f"{len(snap.get('histograms', []))} histograms)")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path")
+    ap.add_argument("--require-serving", action="store_true")
+    a = ap.parse_args()
+    return check(a.path, require_serving=a.require_serving)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
