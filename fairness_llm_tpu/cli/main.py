@@ -133,6 +133,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="with --continuous: concurrent KV slots "
                         "(= decode-step batch rows)")
+    p.add_argument("--max-step-seconds", type=float, default=None,
+                   help="resilience watchdog: a compiled prefill/decode step "
+                        "slower than this is classified HUNG and contained "
+                        "as a fault (requeue-once / chunk-retry); implies "
+                        "the per-stage circuit breakers. See "
+                        "docs/RESILIENCE.md")
+    p.add_argument("--breaker-threshold", type=int, default=None,
+                   help="resilience: consecutive faults per stage before "
+                        "that stage's circuit breaker opens (default 3); "
+                        "implies the breakers even without a watchdog")
+    p.add_argument("--breaker-cooldown", type=float, default=None,
+                   help="resilience: seconds an open breaker waits before "
+                        "half-opening for a probe (default 5)")
+    p.add_argument("--serving-journal", default=None, metavar="DIR",
+                   help="with --continuous: crash-safe request journal under "
+                        "DIR (journal.jsonl) + SIGTERM/SIGINT graceful "
+                        "drain; a preempted run's unfinished requests are "
+                        "re-served by `resume-serving DIR`")
+    p.add_argument("--drain-grace", type=float, default=None,
+                   help="with --serving-journal: seconds live slots may "
+                        "keep decoding after a drain signal before being "
+                        "journaled as unfinished (default 5)")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--weight-quant", default=None, choices=("none", "int8"),
@@ -209,6 +231,37 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--slots must be >= 1")
             serve_kwargs["num_slots"] = args.slots
         updates["serving"] = ServingConfig(**serve_kwargs)
+    resilience_flags = (args.max_step_seconds, args.breaker_threshold,
+                        args.breaker_cooldown, args.serving_journal,
+                        args.drain_grace)
+    if any(v is not None for v in resilience_flags):
+        from fairness_llm_tpu.config import ResilienceConfig
+
+        if (args.serving_journal or args.drain_grace is not None) \
+                and not args.continuous:
+            raise SystemExit("--serving-journal/--drain-grace require "
+                             "--continuous (the journal ledgers serving "
+                             "requests)")
+        res_kwargs: Dict = {"enabled": True}
+        if args.max_step_seconds is not None:
+            if args.max_step_seconds <= 0:
+                raise SystemExit("--max-step-seconds must be > 0")
+            res_kwargs["max_step_seconds"] = args.max_step_seconds
+        if args.breaker_threshold is not None:
+            if args.breaker_threshold < 1:
+                raise SystemExit("--breaker-threshold must be >= 1")
+            res_kwargs["breaker_threshold"] = args.breaker_threshold
+        if args.breaker_cooldown is not None:
+            if args.breaker_cooldown < 0:
+                raise SystemExit("--breaker-cooldown must be >= 0")
+            res_kwargs["breaker_cooldown_s"] = args.breaker_cooldown
+        if args.serving_journal:
+            res_kwargs["journal_dir"] = args.serving_journal
+        if args.drain_grace is not None:
+            if args.drain_grace < 0:
+                raise SystemExit("--drain-grace must be >= 0")
+            res_kwargs["drain_grace_s"] = args.drain_grace
+        updates["resilience"] = ResilienceConfig(**res_kwargs)
     if updates:
         config = dataclasses.replace(config, **updates)
     return config
@@ -247,6 +300,112 @@ def telemetry_report(argv) -> int:
     return 0
 
 
+def resume_serving_cmd(argv) -> int:
+    """``cli resume-serving <journal-dir>`` — finish the unfinished.
+
+    Loads the serving journal a drained/preempted ``--continuous`` run left
+    behind and re-serves every request without a terminal record, with its
+    ORIGINAL id, sampler settings, and row seed (greedy survivors decode
+    the exact tokens an uninterrupted run would) and its deadline reduced
+    by the wall time already spent. See docs/RESILIENCE.md.
+    """
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu resume-serving",
+        description="Re-serve a drained run's journaled unfinished requests",
+    )
+    ap.add_argument("journal_dir", help="directory holding journal.jsonl "
+                                        "(the --serving-journal DIR)")
+    ap.add_argument("--model", required=True,
+                    help="engine model name (must match the drained run)")
+    ap.add_argument("--weights-dir", default=None)
+    ap.add_argument("--allow-random", action="store_true",
+                    help="serve with randomly initialized weights (smoke "
+                         "runs / chaos drills only)")
+    ap.add_argument("--slots", type=int, default=None)
+    ap.add_argument("--max-new-tokens", type=int, default=None,
+                    help="serving decode cap (default: the serving default, "
+                         "clamped to fit the model's position budget)")
+    ap.add_argument("--max-step-seconds", type=float, default=None)
+    ap.add_argument("--breaker-threshold", type=int, default=None)
+    ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    a = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if a.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    from fairness_llm_tpu.config import ResilienceConfig, ServingConfig
+    from fairness_llm_tpu.pipeline.backends import backend_for
+    from fairness_llm_tpu.resilience import (
+        GracefulDrain,
+        ServingJournal,
+        resume_serving,
+    )
+
+    config = default_config()
+    res_kwargs: Dict = {"enabled": True, "journal_dir": a.journal_dir}
+    if a.max_step_seconds is not None:
+        res_kwargs["max_step_seconds"] = a.max_step_seconds
+    if a.breaker_threshold is not None:
+        res_kwargs["breaker_threshold"] = a.breaker_threshold
+    serve_kwargs: Dict = {"enabled": True}
+    if a.slots is not None:
+        serve_kwargs["num_slots"] = a.slots
+    from fairness_llm_tpu.models.configs import get_model_config
+
+    # The scheduler requires max_new_tokens < the model's max_seq_len (a
+    # KV-slot row holds prompt bucket + decode cap). Clamp the DEFAULT so
+    # small study models resume without ceremony; an explicit flag is taken
+    # verbatim and fails loudly if it can't fit.
+    model_seq = get_model_config(a.model).max_seq_len
+    serve_kwargs["max_new_tokens"] = (
+        a.max_new_tokens if a.max_new_tokens is not None
+        else min(ServingConfig().max_new_tokens, model_seq // 2)
+    )
+    config = dataclasses.replace(
+        config,
+        weights_dir=a.weights_dir,
+        serving=ServingConfig(**serve_kwargs),
+        resilience=ResilienceConfig(**res_kwargs),
+        telemetry_dir=a.telemetry_dir,
+    )
+    sink = None
+    if a.telemetry_dir:
+        from fairness_llm_tpu import telemetry as T
+
+        sink = T.configure(a.telemetry_dir)
+    # The backend owns the engine build (weights, quant, single-device
+    # guard); its journal handle is the same ledger we resume from, so
+    # completions append terminal records and a SECOND preemption during
+    # the resume re-journals the still-unfinished tail.
+    backend = backend_for(a.model, config, allow_random=a.allow_random)
+    journal = backend.journal or ServingJournal(a.journal_dir)
+    with GracefulDrain():
+        results = resume_serving(
+            backend.engine, journal, serving=backend.serving,
+            resilience=config.resilience,
+        )
+    outcomes: Dict[str, int] = {}
+    for res in results.values():
+        outcomes[res.finish_reason] = outcomes.get(res.finish_reason, 0) + 1
+    print(f"resumed {len(results)} request(s): "
+          + (", ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))
+             or "nothing to do"))
+    still = journal.unfinished()
+    if still:
+        print(f"{len(still)} request(s) remain unfinished (drained again?) — "
+              f"re-run resume-serving {a.journal_dir}")
+    if a.telemetry_dir:
+        from fairness_llm_tpu import telemetry as T
+
+        path = T.write_snapshot(T.get_registry(), a.telemetry_dir)
+        print(f"telemetry snapshot: {path}")
+        if sink is not None:
+            T.install_event_sink(None)
+            sink.close()
+    return 1 if still else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -254,6 +413,8 @@ def main(argv=None) -> int:
         # Subcommand dispatch ahead of the study parser (whose --all/--phase
         # group is required and would reject it).
         return telemetry_report(argv[1:])
+    if argv and argv[0] == "resume-serving":
+        return resume_serving_cmd(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
@@ -275,10 +436,27 @@ def main(argv=None) -> int:
 
     from fairness_llm_tpu.utils import maybe_trace, phase_timer
 
+    drain_handler = None
+    if config.resilience.enabled and config.serving.enabled:
+        # SIGTERM/SIGINT drain: the serving scheduler polls the handler's
+        # flag each loop iteration, stops admission, finishes what it can
+        # within --drain-grace, and journals the rest (when --serving-journal
+        # is set) for `resume-serving`. Second signal = normal kill.
+        from fairness_llm_tpu.resilience import GracefulDrain
+
+        drain_handler = GracefulDrain().install()
+
     phases = [1, 2, 3] if args.all else [args.phase]
     timings: Dict[str, float] = {}
     p1 = None
     for phase in phases:
+        if drain_handler is not None and drain_handler.requested:
+            # A drain mid-phase already preempted/journaled that phase's
+            # serving work; running the REMAINING phases would just burn
+            # the preemption window producing instantly-preempted results.
+            # Stop at the boundary and get to the snapshot/journal note.
+            print(f"\ndrain requested — skipping phase {phase} and beyond")
+            break
         with phase_timer(f"phase {phase}", timings), maybe_trace(
             config.profile_trace_dir, f"phase{phase}"
         ):
@@ -316,6 +494,13 @@ def main(argv=None) -> int:
                     from fairness_llm_tpu.reports import generate_phase3_figure
 
                     generate_phase3_figure(p3, f"{config.results_dir}/visualizations")
+
+    if drain_handler is not None:
+        drain_handler.uninstall()
+        if drain_handler.requested:
+            print("\nNOTE: run was drained by a signal; unfinished serving "
+                  "requests (if a --serving-journal was set) can be "
+                  "finished with: resume-serving <journal-dir>")
 
     if config.profile_trace_dir:
         # Terminal-friendly device-op breakdown of the captured trace — the

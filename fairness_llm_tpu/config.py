@@ -81,6 +81,38 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Watchdog / circuit-breaker / graceful-drain knobs (``resilience/``).
+
+    ``enabled`` arms the step watchdog and the per-stage breakers in the
+    serving scheduler (and the engine's speculate breaker). In a fault-free
+    run they change NOTHING but a few host-side timestamps — the watchdog
+    only classifies steps slower than ``max_step_seconds``, and a breaker
+    only acts after ``breaker_threshold`` consecutive faults — which is why
+    the bench guard (docs/PERFORMANCE.md) can pin their overhead at noise.
+
+    ``journal_dir`` turns on the crash-safe serving journal: accepted
+    requests are ledgered to ``<dir>/journal.jsonl`` and a drained/preempted
+    run's unfinished work is re-servable with ``resume-serving <dir>``.
+    """
+
+    enabled: bool = False
+    # Watchdog: a compiled step slower than this is classified hung and
+    # raised as a containable HangFault. 0 disables classification (the
+    # step_wall_s histogram still records, so thresholds can be chosen
+    # from real data first).
+    max_step_seconds: float = 0.0
+    breaker_threshold: int = 3  # consecutive faults per stage -> open
+    breaker_cooldown_s: float = 5.0  # open -> half-open probe delay
+    # Drain: how long live slots may keep decoding after SIGTERM/SIGINT
+    # before being journaled as unfinished (preemption notice is ~30s on
+    # most preemptible fleets; leave headroom for the snapshot write).
+    drain_grace_s: float = 5.0
+    journal_dir: Optional[str] = None
+    journal_rotate_every: int = 256  # terminal records between compactions
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes follow the scaling-book convention:
 
@@ -178,6 +210,12 @@ class Config:
     # batch shape lose nothing, and the static path remains the reference
     # numerics). --continuous on the CLI flips enabled.
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # Resilience: step watchdog + per-stage circuit breakers + graceful
+    # drain/journal (off by default; --max-step-seconds/--serving-journal
+    # and friends flip it on). See docs/RESILIENCE.md.
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
 
     def settings_for(self, model_name: str) -> ModelSettings:
         for name, settings in self.model_settings:

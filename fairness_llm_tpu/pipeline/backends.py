@@ -357,6 +357,9 @@ def backend_for(
         seed=config.random_seed,
         assume_sharded=loaded_sharded,
     )
+    resilience = getattr(config, "resilience", None)
+    if resilience is not None and not resilience.enabled:
+        resilience = None
     if use_serving:
         # Continuous-batching server (--continuous): same DecodeBackend
         # surface, slot-recycled decode underneath. Single-device only
@@ -364,10 +367,34 @@ def backend_for(
         # compose with the step-wise serving loop yet, so it is ignored.
         from fairness_llm_tpu.serving import ServingBackend
 
-        return ServingBackend(engine, serving, name=model_name)
+        journal = None
+        if resilience is not None and resilience.journal_dir:
+            from fairness_llm_tpu.resilience import ServingJournal
+
+            journal = ServingJournal(
+                resilience.journal_dir,
+                rotate_every=resilience.journal_rotate_every,
+            )
+        return ServingBackend(engine, serving, name=model_name,
+                              resilience=resilience, journal=journal)
     # Speculation rides on the backend (not the engine default) so sweeps
     # opted in via Config get it while direct engine users stay explicit.
     spec = getattr(config, "speculation", None)
+    if resilience is not None:
+        # Engine-only path still gets the watchdog (hang classification on
+        # generate calls, contained by with_failure_containment) and a
+        # board for the speculate gate.
+        from fairness_llm_tpu.resilience import BreakerBoard, StepWatchdog
+
+        engine.breakers = BreakerBoard(
+            failure_threshold=resilience.breaker_threshold,
+            cooldown_s=resilience.breaker_cooldown_s,
+            component="engine",
+        )
+        if resilience.max_step_seconds > 0:
+            engine.watchdog = StepWatchdog(
+                resilience.max_step_seconds, component="engine"
+            )
     return EngineBackend(
         engine, name=model_name,
         speculation=spec if (spec is not None and spec.enabled) else None,

@@ -1,0 +1,139 @@
+"""Step-progress watchdog: classify a compiled step as hung.
+
+The serving scheduler and the decode engine already stamp per-step liveness
+into telemetry — ``step_last_completed_ts`` gauges updated after every
+compiled prefill/decode call (``serving/scheduler.py``,
+``runtime/engine.py``) and the 30 s ``Heartbeat`` pulse. What was missing is
+a *policy* on top of those timestamps: a compiled call that never returns
+(device lockup, a deadlocked collective, a preempted-but-not-killed TPU
+host) stalls the single-threaded loop forever with no signal distinguishing
+"slow" from "dead".
+
+``StepWatchdog`` is that policy, in two modes sharing one threshold:
+
+- **Inline enforcement** (the containment path): the loop ``arm()``s before
+  a compiled call and ``observe()``s after it; a step whose wall time
+  exceeds ``max_step_seconds`` raises :class:`HangFault` — a subclass of
+  ``DecodeFault``, so every existing containment path (slot requeue in the
+  scheduler, chunk retry in ``with_failure_containment``) already knows how
+  to absorb it. Inline classification is necessarily *post-hoc* (a
+  single-threaded loop cannot interrupt its own blocked call), which is the
+  honest contract: the value is turning "silently 40x slower than budget"
+  into a contained, counted, breaker-visible fault instead of a mystery —
+  and on preemptible hardware a stuck-then-resumed step IS the common case.
+- **External stall detection** (``stalled()``): any other thread/process
+  holding a registry reads the ``step_last_completed_ts`` gauge and gets
+  back how long the loop has gone without completing a step — the
+  supervisor-side view for process-level kill/restart decisions that the
+  inline mode, by construction, cannot make.
+
+Hangs are injectable without real sleeps: ``ScriptedFaultInjector``
+(``utils/failures.py``) has a hang mode whose simulated seconds feed
+``observe(extra_s=...)``, and ``clock`` is injectable for tests.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.utils.failures import HangFault
+
+# The gauge the scheduler/engine loops stamp after every completed compiled
+# step; ``stalled()`` reads it back. One gauge per component label.
+LAST_STEP_GAUGE = "step_last_completed_ts"
+
+
+def mark_step_completed(component: str, clock: Callable[[], float] = time.monotonic) -> None:
+    """Stamp the shared liveness gauge (monotonic clock — ``stalled()``
+    computes durations from it, never wall-clock math)."""
+    get_registry().gauge(LAST_STEP_GAUGE, component=component).set(clock())
+
+
+class StepWatchdog:
+    """Hang classification for one component's compiled-step loop.
+
+    ``max_step_seconds <= 0`` disables classification (``observe`` still
+    feeds the ``step_wall_s`` histogram, so the threshold can be chosen from
+    real data before enforcement is turned on).
+    """
+
+    def __init__(
+        self,
+        max_step_seconds: float,
+        component: str = "serving",
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.max_step_seconds = float(max_step_seconds)
+        self.component = component
+        self.clock = clock
+        self._armed: Dict[str, float] = {}  # stage -> arm timestamp
+
+    def arm(self, stage: str) -> float:
+        """Mark a compiled call about to start; returns the arm timestamp."""
+        t = self.clock()
+        self._armed[stage] = t
+        return t
+
+    def observe(
+        self,
+        stage: str,
+        elapsed: Optional[float] = None,
+        extra_s: float = 0.0,
+        classify: bool = True,
+    ) -> float:
+        """Record one completed step and classify it.
+
+        ``elapsed`` overrides the armed-clock measurement (callers that
+        already timed the call); ``extra_s`` adds simulated hang seconds from
+        the fault injector so chaos drills never really sleep. Raises
+        :class:`HangFault` when the total exceeds ``max_step_seconds``.
+
+        ``classify=False`` records the histogram but skips classification —
+        for steps whose wall legitimately includes one-off work the budget
+        was never meant to cover (first-use XLA compilation: easily minutes
+        for a big model, and faulting it would requeue healthy requests and
+        feed the breakers on a perfectly healthy run). Injected stalls
+        (``extra_s > 0``) classify regardless, so scripted chaos is never
+        masked by a compile.
+        """
+        if elapsed is None:
+            armed = self._armed.pop(stage, None)
+            elapsed = 0.0 if armed is None else self.clock() - armed
+        else:
+            self._armed.pop(stage, None)
+        total = float(elapsed) + float(extra_s)
+        reg = get_registry()
+        reg.histogram("step_wall_s", component=self.component,
+                      stage=stage).observe(total)
+        reg.gauge("watchdog_last_step_s", component=self.component).set(total)
+        mark_step_completed(self.component, self.clock)
+        if self.max_step_seconds > 0 and total > self.max_step_seconds \
+                and (classify or extra_s > 0):
+            reg.counter("watchdog_hangs_total", component=self.component,
+                        stage=stage).inc()
+            emit_event("watchdog_hang", component=self.component, stage=stage,
+                       step_s=round(total, 3),
+                       max_step_seconds=self.max_step_seconds)
+            raise HangFault(
+                f"{self.component} {stage} step took {total:.3f}s "
+                f"(> max_step_seconds {self.max_step_seconds:g})"
+            )
+        return total
+
+    def stalled(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds past ``max_step_seconds`` since the component last
+        completed a step, read from the telemetry gauge — None while healthy
+        or before any step completed. The external-monitor view: does not
+        raise, does not require this object to be the one arming steps."""
+        # peek, not gauge(): an observer must not create a zero-valued gauge
+        # (which would read as "last step at t=0 = stalled forever").
+        g = get_registry().peek(LAST_STEP_GAUGE, component=self.component)
+        if g is None or not g.value:
+            return None
+        now = self.clock() if now is None else now
+        idle = now - g.value
+        if self.max_step_seconds > 0 and idle > self.max_step_seconds:
+            return idle - self.max_step_seconds
+        return None

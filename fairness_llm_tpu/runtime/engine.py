@@ -129,6 +129,21 @@ class DecodeEngine:
         engine-wide default for ``generate`` (per-call arg overrides)."""
         self.config = model_config
         self.speculation = speculation
+        # Resilience hooks (resilience/): ``breakers`` — a BreakerBoard whose
+        # "speculate" stage gates the speculative path (a persistently-
+        # failing spec program trips it open and generate falls back to the
+        # plain path, identical output by construction); ``watchdog`` — a
+        # StepWatchdog that classifies an over-budget generate call as a
+        # containable HangFault. Both default off; backend_for/ServingBackend
+        # install them when ResilienceConfig.enabled.
+        self.breakers = None
+        self.watchdog = None
+        # Degradation-ladder shed state (see shed_speculation below): kept
+        # ON the engine because several schedulers may share it — a
+        # per-caller saved copy could capture an already-shed None and
+        # "restore" speculation to permanently off.
+        self._spec_shed = False
+        self._spec_saved_speculation = None
         self.tokenizer = tokenizer or tokenizer_for(model_config, tokenizer_path)
         self.mesh = mesh
         if mesh is None and mesh_config is not None and mesh_config.num_devices > 1:
@@ -495,6 +510,24 @@ class DecodeEngine:
 
     # -- public API ---------------------------------------------------------
 
+    def shed_speculation(self) -> None:
+        """Degradation rung 1 (resilience/breaker.py): disable the engine's
+        default speculation, remembering the original config. Idempotent —
+        the first shedding caller wins; later callers (other schedulers
+        sharing this engine) are no-ops, so restore can never capture an
+        already-shed None."""
+        if not self._spec_shed:
+            self._spec_saved_speculation = self.speculation
+            self.speculation = None
+            self._spec_shed = True
+
+    def restore_speculation(self) -> None:
+        """Undo ``shed_speculation`` (ladder retreat). No-op unless shed."""
+        if self._spec_shed:
+            self.speculation = self._spec_saved_speculation
+            self._spec_saved_speculation = None
+            self._spec_shed = False
+
     def generate(
         self,
         prompts: Sequence[str],
@@ -526,6 +559,10 @@ class DecodeEngine:
         use_spec = bool(
             spec is not None and spec.enabled and spec.draft_len > 0
             and speculation_applicable(sampler) and max_new > 1
+            # Breaker-gated (resilience/breaker.py): an open "speculate"
+            # breaker sheds the speculative path until its half-open probe —
+            # output is identical either way, so this is pure degradation.
+            and (self.breakers is None or self.breakers.allow("speculate"))
         )
 
         # The cache (and, for learned-position models, the position table) holds
@@ -650,6 +687,11 @@ class DecodeEngine:
                 )
             return self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
 
+        # Snapshot for the watchdog's compile exemption below: if this call
+        # grows the compiled-program cache (first use of a shape, a VMEM/
+        # spec fallback rebuild, a fresh prefix KV), its wall includes
+        # compile time and must not classify as a hang.
+        n_compiled_before = len(self._compiled)
         fn = build_fn()
         tokens_j = jnp.asarray(tokens)
         valid_j = jnp.asarray(valid)
@@ -707,35 +749,68 @@ class DecodeEngine:
                     return f(*args)
             return f(*args)
 
+        if self.watchdog is not None:
+            self.watchdog.arm("decode")
+        # Set by either in-call degradation below: a call that failed once,
+        # rebuilt/recompiled, and retried is by definition not a steady-state
+        # step — its combined wall must not classify as a hang (the compile-
+        # growth check alone can coincide when the retry's program was
+        # already cached).
+        degraded_in_call = False
         try:
             res = call(fn)
-        except Exception as e:  # noqa: BLE001 — VMEM-gate miss fallback
-            # The fused decode-attention kernel's eligibility gate is a
-            # calibrated VMEM model (ops/decode_attention._block_bytes), not
-            # an exact accounting — a shape where it under-predicts passes
-            # the gate and Mosaic rejects the program at compile time. That
-            # must degrade to the XLA path, not fail the study: rebuild this
-            # engine without the kernel and recompile once.
+        except Exception as e:  # noqa: BLE001 — two in-call degradations below
+            degraded_in_call = True
             msg = str(e).lower()
-            if not (
+            if (
                 self.config.use_decode_attention_kernel
                 and ("vmem" in msg or "mosaic" in msg or "scoped" in msg)
             ):
+                # VMEM-gate miss fallback: the fused decode-attention
+                # kernel's eligibility gate is a calibrated VMEM model
+                # (ops/decode_attention._block_bytes), not an exact
+                # accounting — a shape where it under-predicts passes the
+                # gate and Mosaic rejects the program at compile time. That
+                # must degrade to the XLA path, not fail the study: rebuild
+                # this engine without the kernel and recompile once.
+                logger.warning(
+                    "fused decode-attention kernel failed to compile (%s); "
+                    "falling back to the XLA attention path for this engine",
+                    type(e).__name__,
+                )
+                self.config = dataclasses.replace(
+                    self.config, use_decode_attention_kernel=False
+                )
+                self.model = Transformer(self.config)
+                self._compiled = {
+                    k: v for k, v in self._compiled.items()
+                    if k[0] == "prefix_kv"
+                }
+                fn = build_fn()
+                res = call(fn)
+            elif use_spec and self.breakers is not None:
+                # Speculative-path failure with a breaker armed: count it
+                # (enough consecutive ones trip "speculate" open, shedding
+                # the path until a half-open probe) and retry THIS call on
+                # the plain path — greedy output is identical by
+                # construction, so the caller never sees the degradation.
+                self.breakers.record_failure("speculate")
+                get_registry().counter(
+                    "faults_total", component="engine", kind="device",
+                    stage="speculate",
+                ).inc()
+                logger.warning(
+                    "speculative decode failed (%s: %s); retrying on the "
+                    "plain path", type(e).__name__, e,
+                )
+                use_spec = False
+                fn = build_fn()
+                res = call(fn)
+            else:
                 raise
-            logger.warning(
-                "fused decode-attention kernel failed to compile (%s); "
-                "falling back to the XLA attention path for this engine",
-                type(e).__name__,
-            )
-            self.config = dataclasses.replace(
-                self.config, use_decode_attention_kernel=False
-            )
-            self.model = Transformer(self.config)
-            self._compiled = {
-                k: v for k, v in self._compiled.items() if k[0] == "prefix_kv"
-            }
-            fn = build_fn()
-            res = call(fn)
+        else:
+            if use_spec and self.breakers is not None:
+                self.breakers.record_success("speculate")
         spec_stats = None
         if use_spec:
             toks_dev, out_len_dev, counters_dev = res
@@ -749,6 +824,19 @@ class DecodeEngine:
             )
         else:
             out = np.asarray(jax.device_get(res))[:n]
+        if self.watchdog is not None:
+            # Hang classification once the host has the tokens (post-hoc by
+            # construction — a single-threaded loop can't interrupt its own
+            # blocked call): an over-budget generate raises HangFault, which
+            # with_failure_containment retries once and then sentinels, the
+            # same containment every other decode fault gets. Calls that
+            # compiled (cache grew) are exempt — compile time is not step
+            # time.
+            self.watchdog.observe(
+                "decode",
+                classify=(not degraded_in_call
+                          and len(self._compiled) == n_compiled_before),
+            )
 
         texts = []
         for row in out:

@@ -18,6 +18,11 @@ Differences from ``EngineBackend`` that callers should know:
   exactly like ``EngineBackend.spec_totals``, and the last call's
   ``GenerateOutput`` (with ``stats["serving"]``) is kept on
   ``last_output`` for byte/shape accounting.
+- with ``resilience`` enabled, one ``BreakerBoard`` is shared by every
+  scheduler AND the engine's speculate gate, and the degradation ladder's
+  last rung lives here: at level 3 (``static_fallback``) new ``generate``
+  calls route through the static ``DecodeEngine`` path — the numerically-
+  reference program — until the ladder retreats.
 """
 
 from __future__ import annotations
@@ -27,9 +32,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from fairness_llm_tpu.config import ModelSettings, ServingConfig
+from fairness_llm_tpu.config import ModelSettings, ResilienceConfig, ServingConfig
+from fairness_llm_tpu.resilience.breaker import BreakerBoard
+from fairness_llm_tpu.resilience.drain import ServingJournal
 from fairness_llm_tpu.serving.request import Request
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
+from fairness_llm_tpu.telemetry import get_registry
 
 logger = logging.getLogger(__name__)
 
@@ -40,11 +48,34 @@ class ServingBackend:
     use_shared_prefix = False
 
     def __init__(self, engine, serving: Optional[ServingConfig] = None,
-                 name: Optional[str] = None, fault_injector=None):
+                 name: Optional[str] = None, fault_injector=None,
+                 resilience: Optional[ResilienceConfig] = None,
+                 journal: Optional[ServingJournal] = None):
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.name = name or engine.config.name
         self.fault_injector = fault_injector
+        self.resilience = resilience
+        self.journal = journal
+        self.board: Optional[BreakerBoard] = None
+        if resilience is not None and resilience.enabled:
+            # ONE board for the whole backend: every scheduler's prefill/
+            # decode breakers and the engine's speculate gate share state,
+            # so the ladder sees the process's health, not one sampler
+            # tuple's.
+            self.board = BreakerBoard(
+                failure_threshold=resilience.breaker_threshold,
+                cooldown_s=resilience.breaker_cooldown_s,
+            )
+            self.engine.breakers = self.board
+            if resilience.max_step_seconds > 0 and self.engine.watchdog is None:
+                from fairness_llm_tpu.resilience.watchdog import StepWatchdog
+
+                # The static-fallback rung runs engine.generate directly;
+                # it gets the same hang classification the scheduler has.
+                self.engine.watchdog = StepWatchdog(
+                    resilience.max_step_seconds, component="engine"
+                )
         self.serve_totals = None  # Optional[ServingStats], set lazily
         self.last_output = None  # GenerateOutput of the most recent call
         self._schedulers: dict = {}
@@ -62,6 +93,8 @@ class ServingBackend:
         sched = ContinuousScheduler(
             self.engine, self.serving, settings=settings,
             fault_injector=self.fault_injector,
+            resilience=self.resilience, journal=self.journal,
+            breakers=self.board,
         )
         keys = list(self._schedulers)
         while len(keys) >= 2:
@@ -86,6 +119,44 @@ class ServingBackend:
                 texts=[], tokens=np.zeros((0, 0), np.int32), steps=0
             )
             return []
+        if self.board is not None and self.board.ladder.level >= 3 \
+                and not (self.board.allow("prefill")
+                         and self.board.allow("decode")):
+            # Degradation rung 3: the continuous scheduler has proven
+            # unhealthy enough (repeated breaker trips) that new calls take
+            # the static DecodeEngine path — the least-clever, numerically-
+            # reference program. Greedy output is identical; what is lost
+            # is slot-recycling throughput. The allow() consults above are
+            # what make this rung RECOVERABLE: once the open breakers'
+            # cooldowns elapse they half-open on the consult and the call
+            # falls through to the scheduler as the probe — its outcomes
+            # close (or re-open) the breakers and the ladder walks back
+            # down. Without them, nothing would ever exercise the serving
+            # breakers again and level 3 would be permanent.
+            logger.warning(
+                "degradation level %d (%s): serving %d prompt(s) through "
+                "the static engine", self.board.ladder.level,
+                self.board.ladder.rung, len(prompts),
+            )
+            get_registry().counter(
+                "static_fallback_calls_total", component="serving"
+            ).inc()
+            # Same row-seed formula as EngineBackend/the scheduler path, so
+            # greedy AND sampled outputs stay identical across the fallback
+            # boundary. last_output keeps its contract (the docstring's
+            # byte/shape accounting promise) — serve_totals does NOT count
+            # these calls (nothing was served); static_fallback_calls_total
+            # is the degraded-traffic signal.
+            row_seeds = None
+            if keys is not None:
+                row_seeds = [(_stable_hash(k) ^ seed) & 0xFFFFFFFF
+                             for k in keys]
+            out = self.engine.generate(
+                prompts, settings, seed=seed, row_seeds=row_seeds,
+                share_prefix=False,
+            )
+            self.last_output = out
+            return list(out.texts)
         sched = self.scheduler_for(settings)
         requests = []
         for i, p in enumerate(prompts):

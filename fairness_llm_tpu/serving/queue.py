@@ -35,6 +35,11 @@ class AdmissionQueue:
         self.rate_limiter = rate_limiter
         self._q: Deque[Request] = deque()
         self.rejected = 0  # capacity + rate rejections, for ServingStats
+        # Drain support (resilience/drain.py): a closed queue refuses every
+        # submit — a draining server must stop ACCEPTING work, not just stop
+        # admitting it to slots, or late submitters' requests would sit in a
+        # queue nothing will ever pop.
+        self.closed = False
 
     def __len__(self) -> int:
         return len(self._q)
@@ -51,6 +56,10 @@ class AdmissionQueue:
         already-accepted request (the scheduler's pending-overflow top-up):
         the attempt still respects capacity and quota, but a refusal is not
         a new rejection for the stats."""
+        if self.closed:
+            if count_rejection:
+                self.rejected += 1
+            return False
         if self.full:
             if count_rejection:
                 self.rejected += 1
@@ -61,6 +70,14 @@ class AdmissionQueue:
             return False
         self._q.append(request)
         return True
+
+    def close(self) -> None:
+        """Stop accepting submissions (drain). Queued requests stay poppable
+        — the drain decides whether to finish or journal them."""
+        self.closed = True
+
+    def reopen(self) -> None:
+        self.closed = False
 
     def requeue(self, request: Request) -> None:
         """Front-of-line reinsertion for a fault-requeued request. Bypasses

@@ -73,7 +73,10 @@ class Result:
 
     ``tokens`` matches the engine's per-row convention: generated ids
     including the EOS that stopped the row (when one did), nothing after.
-    ``finish_reason``: "eos" | "length" | "failed" | "deadline".
+    ``finish_reason``: "eos" | "length" | "failed" | "deadline" |
+    "preempted" ("preempted" = a graceful drain journaled the request for
+    ``resume-serving`` instead of finishing it — terminal for THIS process
+    only; see resilience/drain.py).
 
     ``queue_wait_s`` / ``ttft_s`` come from the request's lifecycle spans
     (``telemetry/tracing.py``): admission wait and time-to-first-token, both

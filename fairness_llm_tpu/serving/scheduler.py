@@ -38,6 +38,17 @@ Fault containment (``utils/failures.py``): an injected or device-raised
 decode/prefill fault releases the hit slots and requeues each request once;
 a second fault surfaces as a failed ``Result``. The loop itself never dies.
 
+Resilience (``resilience/``, opt-in via ``ResilienceConfig``): a step
+watchdog classifies over-budget compiled calls as ``HangFault`` (contained
+exactly like a decode fault), per-stage circuit breakers stop hammering a
+persistently-failing prefill/decode (open state skips the stage until a
+half-open probe), breaker trips advance a degradation ladder (drop
+speculation -> halve decode chunk + soft-cap the pool -> the backend's
+static-engine fallback), and a drain request (SIGTERM/SIGINT via
+``GracefulDrain``, or ``request_drain()``) stops admission, gives live
+slots ``drain_grace_s`` to finish, and preempts the rest — journaled
+requests resume in a successor process via ``resume-serving``.
+
 Sharded meshes are not supported yet (the slot scatter would need dp-aware
 placement); serving targets the single-chip engine — multi-replica routing
 is the next layer up, not this one.
@@ -54,15 +65,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fairness_llm_tpu.config import ModelSettings, ServingConfig
+from fairness_llm_tpu.config import ModelSettings, ResilienceConfig, ServingConfig
 from fairness_llm_tpu.models.tokenizer import _left_pad
 from fairness_llm_tpu.models.transformer import LayerCache, init_cache
+from fairness_llm_tpu.resilience.breaker import BreakerBoard
+from fairness_llm_tpu.resilience.drain import (
+    ServingJournal,
+    drain_requested,
+    take_signal_telemetry,
+)
+from fairness_llm_tpu.resilience.watchdog import StepWatchdog
 from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
 from fairness_llm_tpu.serving.queue import AdmissionQueue
 from fairness_llm_tpu.serving.request import Request, Result
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
-from fairness_llm_tpu.telemetry import Heartbeat, RequestTracer, get_registry
-from fairness_llm_tpu.utils.failures import DecodeFault
+from fairness_llm_tpu.telemetry import (
+    Heartbeat,
+    RequestTracer,
+    emit_event,
+    get_registry,
+)
+from fairness_llm_tpu.utils.failures import DecodeFault, HangFault
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
 
@@ -93,6 +116,9 @@ class ContinuousScheduler:
         serving: Optional[ServingConfig] = None,
         settings: Optional[ModelSettings] = None,
         fault_injector=None,
+        resilience: Optional[ResilienceConfig] = None,
+        journal: Optional[ServingJournal] = None,
+        breakers: Optional[BreakerBoard] = None,
     ):
         if engine.mesh is not None:
             raise ValueError(
@@ -167,6 +193,33 @@ class ContinuousScheduler:
         # the process registry. Always on — host-side timestamps only.
         self.tracer = RequestTracer(component="serving")
         self._heartbeat = Heartbeat(interval_s=30.0, name="serving")
+        # Resilience (resilience/): watchdog + breakers arm only when the
+        # config enables them (or a shared BreakerBoard is handed in, the
+        # ServingBackend case); the journal ledgers intake when present. In
+        # a fault-free run these cost a few host-side timestamps per chunk
+        # — the bench guard in docs/PERFORMANCE.md pins that at noise.
+        self.resilience = resilience or ResilienceConfig()
+        r = self.resilience
+        if breakers is not None:
+            self.breakers: Optional[BreakerBoard] = breakers
+        elif r.enabled:
+            self.breakers = BreakerBoard(
+                failure_threshold=r.breaker_threshold,
+                cooldown_s=r.breaker_cooldown_s,
+            )
+        else:
+            self.breakers = None
+        self.watchdog: Optional[StepWatchdog] = (
+            StepWatchdog(r.max_step_seconds)
+            if r.enabled and r.max_step_seconds > 0 else None
+        )
+        self.journal = journal
+        self._drain_flag = False
+        # Degradation-ladder state: rung 2 halves the decode chunk and
+        # soft-caps concurrent slots; both restore when the ladder retreats.
+        self._base_decode_chunk = self.decode_chunk
+        self.live_cap = self.num_slots
+        self._applied_level = 0
 
     # -- compiled programs --------------------------------------------------
 
@@ -250,7 +303,11 @@ class ContinuousScheduler:
         (EOS or the row's own budget) instead of a batch-uniform cap. Early
         exit when every live row finishes mid-chunk.
         """
-        fn = self._compiled.get("serve_step")
+        # The chunk length is baked into the compiled while_loop, and the
+        # degradation ladder can change it mid-run — key on it so a halved
+        # chunk compiles its own program and restoring reuses the original.
+        key = ("serve_step", self.decode_chunk)
+        fn = self._compiled.get(key)
         if fn is not None:
             return fn
         cfg = self.engine.config
@@ -312,7 +369,7 @@ class ContinuousScheduler:
             return cache, prev_logits, toks, emitted, counters
 
         fn = jax.jit(run, donate_argnums=self._donate())
-        self._compiled["serve_step"] = fn
+        self._compiled[key] = fn
         return fn
 
     # -- submission ---------------------------------------------------------
@@ -346,6 +403,11 @@ class ContinuousScheduler:
             # them and the next drain publishes the delta as
             # serving_rejected_total — one source of truth.
             self.tracer.record(request.id, "submitted", t=request.submitted_at)
+            if self.journal is not None:
+                # Ledger at ACCEPTANCE (not admission): from here on the
+                # request must reach a terminal Result or survive in the
+                # journal — the zero-lost contract a preemption is judged on.
+                self.journal.record_submitted(request)
         return accepted
 
     def take_result(self, request_id: str) -> Optional[Result]:
@@ -389,6 +451,8 @@ class ContinuousScheduler:
         for r in requests:
             r.submitted_at = now
             self.tracer.record(r.id, "submitted", t=now)
+            if self.journal is not None:
+                self.journal.record_submitted(r)
         self._pending = deque(requests)
         self._run_loop(stats)
         self.last_stats = stats
@@ -396,18 +460,36 @@ class ContinuousScheduler:
 
     # -- internals ----------------------------------------------------------
 
+    def request_drain(self) -> None:
+        """Programmatic drain trigger (the signal path is ``GracefulDrain``):
+        the loop stops admission at its next iteration, finishes what it can
+        within ``drain_grace_s``, and preempts the rest to the journal."""
+        self._drain_flag = True
+
+    def _drain_requested(self) -> bool:
+        # Own flag OR the process-wide one a GracefulDrain handler sets —
+        # so one SIGTERM drains every scheduler in the process.
+        return self._drain_flag or drain_requested()
+
     def _run_loop(self, stats: ServingStats) -> None:
         self._feed(stats)
         while self._pending or len(self.queue) or self.pool.occupancy:
+            if self._drain_requested():
+                self._execute_drain(stats)
+                break
+            self._apply_degradation()
             progressed = self._iterate(stats)
             self._feed(stats)
             self._heartbeat.poke(
                 occupancy=self.pool.occupancy, queue_depth=len(self.queue),
                 completed=stats.completed, decoded_tokens=stats.decoded_tokens,
             )
-            if not progressed and not self.pool.occupancy:
-                # Rate-limited admission with nothing decoding: yield briefly
-                # instead of spinning the loop dry.
+            if not progressed:
+                # Nothing moved this iteration — rate-limited admission with
+                # an empty pool, or an OPEN breaker refusing the stage while
+                # work waits. Yield briefly instead of spinning the loop dry
+                # (a fault-free loop with work always progresses, so this
+                # never fires on the hot path).
                 time.sleep(0.002)
         # Attribute queue rejections not yet reported by an earlier drain —
         # including public submit() refusals made BETWEEN drains (the
@@ -417,6 +499,99 @@ class ContinuousScheduler:
         # One publish per drain: the registry accumulates process totals
         # while this ServingStats object stays the per-drain record.
         stats.publish()
+
+    def _apply_degradation(self) -> None:
+        """Make the scheduler's knobs match the ladder's current rung.
+
+        Effects-by-polling (once per loop iteration): rung 1 sheds the
+        engine's speculation config (a pure-throughput feature — output is
+        identical by construction, so it is the cheapest thing to lose),
+        rung 2 halves the decode chunk and soft-caps concurrent slots at
+        half the pool (smaller compiled steps, smaller blast radius per
+        fault). Everything restores as the ladder retreats. Rung 3 (static
+        fallback) is applied by ``ServingBackend``, not here — a scheduler
+        cannot turn itself into the static engine mid-loop.
+        """
+        if self.breakers is None:
+            return
+        lvl = self.breakers.ladder.level
+        if lvl == self._applied_level:
+            return
+        # Shed/restore state lives on the ENGINE (idempotent methods) and
+        # is driven unconditionally by the current level: several
+        # schedulers can share one engine + one board, and any per-
+        # scheduler bookkeeping could capture an already-shed None or be
+        # LRU-evicted before it restores.
+        if lvl >= 1:
+            self.engine.shed_speculation()
+        else:
+            self.engine.restore_speculation()
+        if lvl >= 2:
+            self.decode_chunk = max(1, self._base_decode_chunk // 2)
+            self.live_cap = max(1, self.num_slots // 2)
+        else:
+            self.decode_chunk = self._base_decode_chunk
+            self.live_cap = self.num_slots
+        logger.warning(
+            "degradation rung %d (%s) applied: speculation=%s "
+            "decode_chunk=%d live_cap=%d",
+            lvl, self.breakers.ladder.rung,
+            "shed" if self.engine._spec_shed else "kept",
+            self.decode_chunk, self.live_cap,
+        )
+        self._applied_level = lvl
+
+    def _execute_drain(self, stats: ServingStats) -> None:
+        """Stop admission, give live slots ``drain_grace_s`` to finish,
+        preempt everything else. Queued/pending requests never got a slot,
+        so there is nothing partial to save — they preempt immediately."""
+        n_queued, n_pending = len(self.queue), len(self._pending)
+        n_live = self.pool.occupancy
+        # Deferred signal telemetry: the SIGTERM/SIGINT handler only sets
+        # flags (signal context can't safely log/emit); this is the safe
+        # context that records which signals asked for the drain.
+        take_signal_telemetry()
+        logger.warning(
+            "draining: admission stopped (%d queued, %d pending, %d live)",
+            n_queued, n_pending, n_live,
+        )
+        emit_event("drain_started", queued=n_queued, pending=n_pending,
+                   live=n_live)
+        get_registry().counter("drains_total", component="serving").inc()
+        self.queue.close()
+        try:
+            for req in self._pending:
+                self._preempt(req, stats)
+            self._pending.clear()
+            for req in self.queue.pop(len(self.queue)):
+                self._preempt(req, stats)
+            completed_before = stats.completed
+            grace = self.resilience.drain_grace_s
+            t0 = time.monotonic()
+            while self.pool.occupancy and time.monotonic() - t0 < grace:
+                if not self._decode(stats):  # breaker may refuse the stage
+                    time.sleep(0.002)
+            for slot in self.pool.live_slots():
+                st = self.pool.release(slot)
+                self._preempt(st.request, stats)
+            # A fault/hang DURING the grace loop requeues its victims
+            # (requeue bypasses the closed queue by design) — sweep the
+            # queue again so they preempt instead of stranding without a
+            # Result (serve() would KeyError on them otherwise).
+            for req in self.queue.pop(len(self.queue)):
+                self._preempt(req, stats)
+            # Released rows keep their pending invalidation: a later serve
+            # on this scheduler resets them via the step mask (or prefill
+            # re-init on realloc), same as any other release.
+        finally:
+            self.queue.reopen()
+            # One programmatic drain per request_drain() call — the
+            # scheduler stays reusable afterwards. The PROCESS-wide signal
+            # flag (GracefulDrain) intentionally stays set: that process is
+            # on its way out, and every later serve should drain too.
+            self._drain_flag = False
+        emit_event("drain_complete", preempted=stats.preempted,
+                   completed_during_drain=stats.completed - completed_before)
 
     def _feed(self, stats: ServingStats) -> None:
         # Internal top-up from serve()'s pending overflow: a failed attempt
@@ -433,10 +608,8 @@ class ContinuousScheduler:
         tok = self.engine.tokenizer
         ids = list(tokens or [])
         text = tok.decode([t for t in ids if t != tok.eos_id])
-        row = self.tracer.finalize(
-            request.id, "expired" if reason == "deadline" else "failed",
-            tokens=len(ids),
-        )
+        outcome = "expired" if reason == "deadline" else "failed"
+        row = self.tracer.finalize(request.id, outcome, tokens=len(ids))
         self._results[request.id] = Result(
             id=request.id, ok=False, text=text,
             tokens=np.asarray(ids, np.int32), finish_reason=reason,
@@ -444,10 +617,29 @@ class ContinuousScheduler:
             latency_s=time.monotonic() - request.submitted_at,
             queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
         )
+        if self.journal is not None:
+            self.journal.record_terminal(request.id, outcome)
         if reason == "deadline":
             stats.expired += 1
         else:
             stats.failed += 1
+
+    def _preempt(self, request: Request, stats: ServingStats) -> None:
+        """Drain outcome for a request this process will not finish: a
+        ``preempted`` Result here, NO terminal journal record — the journal
+        entry staying unfinished is exactly what ``resume-serving`` reads."""
+        row = self.tracer.finalize(request.id, "preempted", tokens=0)
+        hint = (f"resume with: resume-serving {self.journal.journal_dir}"
+                if self.journal is not None
+                else "no serving journal configured; request is lost at exit")
+        self._results[request.id] = Result(
+            id=request.id, ok=False, finish_reason="preempted",
+            error=f"drained before completion ({hint})",
+            retries=request.retries,
+            latency_s=time.monotonic() - request.submitted_at,
+            queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
+        )
+        stats.preempted += 1
 
     def _requeue_or_fail(self, request: Request, error: str,
                          stats: ServingStats, cause: str = "device") -> None:
@@ -489,6 +681,8 @@ class ContinuousScheduler:
             latency_s=time.monotonic() - req.submitted_at,
             queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
         )
+        if self.journal is not None:
+            self.journal.record_terminal(req.id, "completed")
         stats.completed += 1
 
     def _cap_for(self, request: Request) -> int:
@@ -508,20 +702,44 @@ class ContinuousScheduler:
             any_admitted = True
 
     def _admit_once(self, stats: ServingStats) -> bool:
-        n = min(self.pool.free_count, self.serving.prefill_group,
-                len(self.queue))
+        if self.breakers is not None and not self.breakers.allow("prefill"):
+            return False
+        free = self.pool.free_count
+        if self.live_cap < self.num_slots:
+            # Degradation rung 2: soft-cap concurrent slots. The pool keeps
+            # its compiled size (shapes are baked in); admission just stops
+            # filling it past the cap.
+            free = min(free, max(0, self.live_cap - self.pool.occupancy))
+        n = min(free, self.serving.prefill_group, len(self.queue))
         if n <= 0:
             return False
         popped = self.queue.pop(n)
         tok = self.engine.tokenizer
+        now = time.monotonic()
+        hang_fn = getattr(self.fault_injector, "maybe_hang", None)
+        injected_hang = 0.0
         admitted = []  # (request, row ids, P)
         for req in popped:
+            if req.expired(now):
+                # The deadline passed between the queue's expiry sweep and
+                # this pop — most often while the request sat in the
+                # requeue-after-fault window. It must terminate expired
+                # here, never spend a prefill on a second attempt.
+                self._fail(req, "deadline", "deadline expired before prefill",
+                           stats)
+                continue
             if self.fault_injector is not None:
                 try:
                     self.fault_injector.maybe_fail(req.id, "prefill")
                 except DecodeFault as e:
+                    # Scripted faults feed the breaker like real ones —
+                    # that's what makes breaker trips chaos-drillable.
+                    if self.breakers is not None:
+                        self.breakers.record_failure("prefill")
                     self._requeue_or_fail(req, str(e), stats, cause="injected")
                     continue
+                if hang_fn is not None:
+                    injected_hang += hang_fn(req.id, "prefill")
             ids = tok.encode(req.prompt)
             if len(ids) > self.prompt_budget:
                 # Keep recency, like the engine's truncation — but the
@@ -576,26 +794,44 @@ class ContinuousScheduler:
         valid[len(admitted):, -1] = True
         slot_ids = np.full((nb,), self.num_slots, np.int32)
         slot_ids[: len(admitted)] = slots
+        # First use of this (batch, prompt) bucket compiles; that wall is
+        # exempt from hang classification (injected stalls still classify).
+        first_compile = ("serve_prefill", nb, P) not in self._compiled
         fn = self._prefill_fn(nb, P)
         pf_t0 = time.monotonic()
         for req in reqs:
             self.tracer.record(req.id, "prefill_start", t=pf_t0)
+        if self.watchdog is not None:
+            self.watchdog.arm("prefill")
         try:
             self._cache, self._prev_logits = fn(
                 self.engine.params, self._cache, self._prev_logits,
                 jnp.asarray(tokens), jnp.asarray(valid),
                 jnp.asarray(slot_ids),
             )
+            if self.watchdog is not None:
+                # Post-hoc hang classification (see resilience/watchdog.py):
+                # an over-budget prefill raises HangFault INTO the
+                # containment branch below — the cache rows it wrote are
+                # released with their slots, so nothing stale survives.
+                self.watchdog.observe("prefill", extra_s=injected_hang,
+                                      classify=not first_compile)
         except Exception as e:  # noqa: BLE001 — containment is the point
+            hang = isinstance(e, HangFault)
             logger.warning("prefill batch (%d, %d) failed: %s", nb, P, e)
             get_registry().counter(
-                "faults_total", component="serving", kind="device",
-                stage="prefill",
+                "faults_total", component="serving",
+                kind="hang" if hang else "device", stage="prefill",
             ).inc()
+            if self.breakers is not None:
+                self.breakers.record_failure("prefill")
             for slot, req in zip(slots, reqs):
                 self.pool.release(slot)
-                self._requeue_or_fail(req, f"prefill failed: {e}", stats)
+                self._requeue_or_fail(req, f"prefill failed: {e}", stats,
+                                      cause="hang" if hang else "device")
             return True
+        if self.breakers is not None:
+            self.breakers.record_success("prefill")
         get_registry().histogram(
             "prefill_wall_s", component="serving"
         ).observe(time.monotonic() - pf_t0)
@@ -607,14 +843,25 @@ class ContinuousScheduler:
     def _decode(self, stats: ServingStats) -> bool:
         """One compiled decode chunk over the live slots; evict finished
         rows. Returns True when any decoding happened."""
+        if self.breakers is not None and not self.breakers.allow("decode"):
+            return False
+        injected_hang = 0.0
         if self.fault_injector is not None:
             for slot in self.pool.live_slots():
                 req = self.pool.get(slot).request
                 try:
                     self.fault_injector.maybe_fail(req.id, "decode")
                 except DecodeFault as e:
+                    if self.breakers is not None:
+                        self.breakers.record_failure("decode")
                     self.pool.release(slot)
                     self._requeue_or_fail(req, str(e), stats, cause="injected")
+            hang_fn = getattr(self.fault_injector, "maybe_hang", None)
+            if hang_fn is not None:
+                for slot in self.pool.live_slots():
+                    injected_hang += hang_fn(
+                        self.pool.get(slot).request.id, "decode"
+                    )
         live_ids = self.pool.live_slots()
         if not live_ids:
             return False
@@ -639,7 +886,10 @@ class ContinuousScheduler:
             caps[slot] = self._cap_for(st.request)
             seed = st.request.row_seed
             seeds[slot] = np.uint32((0 if seed is None else seed) & 0xFFFFFFFF)
+        first_compile = ("serve_step", self.decode_chunk) not in self._compiled
         fn = self._step_fn()
+        if self.watchdog is not None:
+            self.watchdog.arm("decode")
         try:
             self._cache, self._prev_logits, toks, emitted_after, counters = fn(
                 self.engine.params, self._cache, self._prev_logits,
@@ -649,15 +899,27 @@ class ContinuousScheduler:
             toks = np.asarray(jax.device_get(toks))
             emitted_after = np.asarray(jax.device_get(emitted_after))
             counters = np.asarray(jax.device_get(counters))
+            if self.watchdog is not None:
+                # Hang classification AFTER the host sees results: a chunk
+                # past max_step_seconds raises HangFault into the branch
+                # below — its tokens are discarded and every rider requeues
+                # for a fresh attempt, exactly like a failed chunk (a hung
+                # step's outputs are unaccounted time, not trusted work).
+                self.watchdog.observe("decode", extra_s=injected_hang,
+                                      classify=not first_compile)
         except Exception as e:  # noqa: BLE001 — containment is the point
+            hang = isinstance(e, HangFault)
             logger.warning("decode chunk failed: %s", e)
             get_registry().counter(
-                "faults_total", component="serving", kind="device",
-                stage="decode",
+                "faults_total", component="serving",
+                kind="hang" if hang else "device", stage="decode",
             ).inc()
+            if self.breakers is not None:
+                self.breakers.record_failure("decode")
             for slot in live_ids:
                 req = self.pool.release(slot).request
-                self._requeue_or_fail(req, f"decode failed: {e}", stats)
+                self._requeue_or_fail(req, f"decode failed: {e}", stats,
+                                      cause="hang" if hang else "device")
             # Every live slot was just released, so nothing in the cache is
             # still needed — rebuild device state from scratch (with TPU
             # buffer donation, a raised call may have consumed the inputs).
@@ -667,6 +929,8 @@ class ContinuousScheduler:
             self._prev_logits = jnp.zeros_like(self._prev_logits)
             self.pool.take_invalidations()
             return True
+        if self.breakers is not None:
+            self.breakers.record_success("decode")
         steps = int(counters[0])
         stats.decode_steps += steps
         stats.occupancy_sum += int(counters[1])

@@ -261,6 +261,25 @@ def _fmt_val(v: Optional[float]) -> str:
     return f"{v:.4g}"
 
 
+# Enum-coded gauges (resilience/breaker.py) rendered by name — "open" reads,
+# "2" doesn't. Kept as a local table: export must not import resilience
+# (resilience imports telemetry; the reverse edge would cycle).
+_STATE_GAUGE_NAMES = {
+    "breaker_state": {0: "closed", 1: "half_open", 2: "open"},
+    "degradation_level": {0: "normal", 1: "no_speculation",
+                          2: "reduced_footprint", 3: "static_fallback"},
+}
+
+
+def _fmt_gauge(row: Dict) -> str:
+    names = _STATE_GAUGE_NAMES.get(row.get("name"))
+    if names is not None:
+        decoded = names.get(int(row["value"])) if row["value"] == int(row["value"]) else None
+        if decoded is not None:
+            return f"{decoded} ({_fmt_val(row['value'])})"
+    return _fmt_val(row["value"])
+
+
 def render_report(snap: Dict, width: int = 78) -> str:
     """Human-readable snapshot report, grouped by ``component`` label —
     the terminal sibling of ``summarize_trace``'s per-device tables."""
@@ -292,7 +311,11 @@ def render_report(snap: Dict, width: int = 78) -> str:
             suffix = f"  {extra}" if extra else ""
             lines.append(f"  {row['name']:<28} {row['value']:>12}{suffix}")
         for row in sec["gauges"]:
-            lines.append(f"  {row['name']:<28} {_fmt_val(row['value']):>12}  (gauge)")
+            extra = {k: v for k, v in row["labels"].items() if k != "component"}
+            suffix = f"  {extra}" if extra else ""
+            lines.append(
+                f"  {row['name']:<28} {_fmt_gauge(row):>12}  (gauge){suffix}"
+            )
         if sec["histograms"]:
             lines.append(
                 f"  {'histogram':<28} {'count':>8} {'mean':>9} {'p50':>9} "

@@ -215,6 +215,13 @@ class MetricsRegistry:
             lambda: Histogram(name, labels, bounds or DEFAULT_LATENCY_BOUNDS),
         )
 
+    def peek(self, name: str, **labels: str) -> Optional[object]:
+        """Read an instrument WITHOUT creating it — for observers (the
+        resilience watchdog's ``stalled()``, diagnostics) that must not
+        materialize zero-valued instruments just by looking. Returns None
+        when no writer has touched that (name, labels) yet."""
+        return self._metrics.get((name, _labels_key(labels)))
+
     # -- export surface -----------------------------------------------------
 
     def instruments(self) -> List[object]:

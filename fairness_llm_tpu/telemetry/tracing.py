@@ -49,11 +49,14 @@ from fairness_llm_tpu.telemetry.registry import (
 
 # Canonical event names, in lifecycle order. ``requeued`` may appear between
 # admitted and a later (second) admitted; terminal events appear exactly once.
+# ``preempted`` is terminal FOR THIS PROCESS only: the request was drained to
+# the serving journal (resilience/drain.py) and a resume-serving run gives it
+# a fresh lifecycle under the same id.
 LIFECYCLE_EVENTS = (
     "submitted", "admitted", "prefill_start", "first_token",
-    "requeued", "completed", "failed", "expired",
+    "requeued", "completed", "failed", "expired", "preempted",
 )
-TERMINAL_EVENTS = ("completed", "failed", "expired")
+TERMINAL_EVENTS = ("completed", "failed", "expired", "preempted")
 
 
 @dataclasses.dataclass

@@ -3,6 +3,7 @@
 from fairness_llm_tpu.utils.profiling import maybe_trace, phase_timer
 from fairness_llm_tpu.utils.failures import (
     DecodeFault,
+    HangFault,
     ScriptedFaultInjector,
     with_failure_containment,
 )
@@ -13,6 +14,7 @@ __all__ = [
     "maybe_trace",
     "phase_timer",
     "DecodeFault",
+    "HangFault",
     "ScriptedFaultInjector",
     "with_failure_containment",
     "print_progress",

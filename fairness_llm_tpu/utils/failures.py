@@ -15,6 +15,11 @@ from __future__ import annotations
 import logging
 from typing import Callable, Dict, List, Optional, Sequence
 
+# Module scope, not the fault hot path: these imports used to run inside
+# every ``maybe_fail`` hit and every contained chunk failure. No cycle:
+# telemetry imports nothing from utils.
+from fairness_llm_tpu.telemetry import get_registry
+
 logger = logging.getLogger(__name__)
 
 
@@ -27,6 +32,17 @@ class DecodeFault(RuntimeError):
     surfaced as a failed ``Result`` — the step loop itself never dies."""
 
 
+class HangFault(DecodeFault):
+    """A compiled step classified as hung by the watchdog
+    (``resilience/watchdog.py``: wall time past ``max_step_seconds``).
+
+    Subclasses ``DecodeFault`` so every existing containment path — slot
+    release + requeue-once in the scheduler, chunk retry in
+    ``with_failure_containment`` — absorbs it without new plumbing; the
+    distinct type is what telemetry labels key on, so chaos reports can
+    tell a hang from an ordinary decode fault."""
+
+
 class ScriptedFaultInjector:
     """Deterministic fault injection for serving tests and chaos drills.
 
@@ -35,11 +51,25 @@ class ScriptedFaultInjector:
     ``"prefill"`` and ``"decode"``. Each ``maybe_fail`` hit decrements the
     budget, so "fail once then succeed" is ``{rid: 1}`` and "fail
     permanently" is ``{rid: 2}`` (the scheduler requeues exactly once).
+
+    ``hangs`` (same key scheme) scripts HANGS instead: each ``maybe_hang``
+    hit returns ``hang_seconds`` of *simulated* stall, which the scheduler
+    feeds to the step watchdog as extra elapsed time — a watchdog-classified
+    ``HangFault`` without ever sleeping, so hang containment is testable in
+    milliseconds.
     """
 
-    def __init__(self, faults: Dict[object, int]):
-        self._budget = dict(faults)
+    def __init__(
+        self,
+        faults: Optional[Dict[object, int]] = None,
+        hangs: Optional[Dict[object, int]] = None,
+        hang_seconds: float = 3600.0,
+    ):
+        self._budget = dict(faults or {})
+        self._hang_budget = dict(hangs or {})
+        self.hang_seconds = float(hang_seconds)
         self.fired: List[tuple] = []  # (request_id, stage) audit log
+        self.hangs_fired: List[tuple] = []
 
     def maybe_fail(self, request_id: str, stage: str) -> None:
         for key in ((request_id, stage), request_id):
@@ -50,8 +80,6 @@ class ScriptedFaultInjector:
                 # Injected faults are labeled apart from device-raised ones
                 # (the scheduler counts those kind="device") so a chaos
                 # drill's telemetry can't be mistaken for a real incident.
-                from fairness_llm_tpu.telemetry import get_registry
-
                 get_registry().counter(
                     "faults_total", component="serving", kind="injected",
                     stage=stage,
@@ -59,6 +87,21 @@ class ScriptedFaultInjector:
                 raise DecodeFault(
                     f"injected {stage} fault for request {request_id!r}"
                 )
+
+    def maybe_hang(self, request_id: str, stage: str) -> float:
+        """Simulated stall seconds this request contributes to the current
+        step (0.0 almost always). Consumes one hang budget per hit."""
+        for key in ((request_id, stage), request_id):
+            n = self._hang_budget.get(key, 0)
+            if n > 0:
+                self._hang_budget[key] = n - 1
+                self.hangs_fired.append((request_id, stage))
+                get_registry().counter(
+                    "faults_total", component="serving",
+                    kind="injected_hang", stage=stage,
+                ).inc()
+                return self.hang_seconds
+        return 0.0
 
 
 def with_failure_containment(
@@ -86,10 +129,12 @@ def with_failure_containment(
                 ))
             except Exception as e:  # noqa: BLE001 — containment is the point
                 last = e
-                from fairness_llm_tpu.telemetry import get_registry
-
+                # error_type label so a chaos report can split HangFault
+                # from DecodeFault from raw device errors without parsing
+                # logs (the bare total is the sum over types).
                 get_registry().counter(
-                    "contained_chunk_failures_total", component="pipeline"
+                    "contained_chunk_failures_total", component="pipeline",
+                    error_type=type(e).__name__,
                 ).inc()
                 logger.warning(
                     "decode chunk failed (attempt %d/%d): %s",

@@ -270,6 +270,9 @@ class ServingStats:
       counts again on its second admission)
     - ``completed`` / ``failed`` / ``expired``: terminal request outcomes
       (``expired`` = deadline passed before completion)
+    - ``preempted``: requests a graceful drain handed to the serving
+      journal instead of finishing (``resilience/drain.py``) — terminal
+      for this process, resumable by the next
     - ``rejected``: submissions refused at the queue (capacity/rate)
     - ``requeued``: fault-hit slots sent back for one retry
     - ``prefill_batches`` / ``prefill_tokens``: compiled prefill forwards and
@@ -288,6 +291,7 @@ class ServingStats:
     completed: int = 0
     failed: int = 0
     expired: int = 0
+    preempted: int = 0
     rejected: int = 0
     requeued: int = 0
     prefill_batches: int = 0
@@ -344,9 +348,9 @@ class ServingStats:
 
         reg = registry if registry is not None else get_registry()
         for name in (
-            "admitted", "completed", "failed", "expired", "rejected",
-            "requeued", "prefill_batches", "prefill_tokens", "decode_steps",
-            "decoded_tokens", "loop_iterations",
+            "admitted", "completed", "failed", "expired", "preempted",
+            "rejected", "requeued", "prefill_batches", "prefill_tokens",
+            "decode_steps", "decoded_tokens", "loop_iterations",
         ):
             reg.counter(f"serving_{name}_total", component=component).inc(
                 getattr(self, name)

@@ -94,6 +94,52 @@ def test_resume_reproduces_uninterrupted_run(config, backend, tmp_path):
         assert resumed["recommendations"][pid]["recommendations"] == rec["recommendations"], pid
 
 
+def test_resume_falls_back_past_torn_checkpoint(tmp_path):
+    """Torn-write regression: a preemption mid-write must never cost the
+    --resume path more than the newest checkpoint. Writes are atomic
+    (tmp + os.replace in results.save_results), so the only way a torn
+    file appears is an OLDER non-atomic writer or filesystem damage —
+    either way, resume must fall back to the newest READABLE checkpoint,
+    not crash and not return nothing."""
+    from fairness_llm_tpu.pipeline import results as R
+
+    good = {"p1": {"recommendations": ["A"], "raw_response": "1. A"}}
+    R.save_checkpoint(good, str(tmp_path), "phase1", 7)
+    # A newer checkpoint torn mid-write: truncated JSON, mid-record.
+    with open(R.checkpoint_path(str(tmp_path), "phase1", 14), "w") as f:
+        f.write('{"completed": 14, "recommendations": {"p1": {"recommen')
+    # And one torn inside a multi-byte character (UnicodeDecodeError path).
+    with open(R.checkpoint_path(str(tmp_path), "phase1", 21), "wb") as f:
+        f.write('{"completed": 21, "recommendations": {"é'.encode()[:-1])
+    assert R.load_latest_checkpoint(str(tmp_path), "phase1") == good
+
+
+def test_save_results_interrupted_write_keeps_previous(tmp_path, monkeypatch):
+    """Kill the process mid-save_results: the destination file must still
+    hold the PREVIOUS complete content (the atomicity --resume depends on),
+    and no tmp litter may accumulate."""
+    import os
+
+    from fairness_llm_tpu.pipeline import results as R
+
+    path = str(tmp_path / "phase1" / "phase1_results.json")
+    R.save_results({"version": 1}, path)
+
+    real_fsync = os.fsync
+
+    def dying_fsync(fd):
+        real_fsync(fd)
+        raise KeyboardInterrupt  # preemption lands mid-write, pre-rename
+
+    monkeypatch.setattr(os, "fsync", dying_fsync)
+    with pytest.raises(KeyboardInterrupt):
+        R.save_results({"version": 2, "huge": "x" * 10000}, path)
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    assert R.load_results(path) == {"version": 1}
+    assert not [p for p in (tmp_path / "phase1").iterdir()
+                if p.name.endswith(".tmp")]
+
+
 def test_phase2_end_to_end(config, backend):
     res = run_phase2(config, models=["simulated"], backends={"simulated": backend},
                      num_items=12, num_comparisons=10)

@@ -1,0 +1,727 @@
+"""Resilience subsystem tests: watchdog, circuit breakers + degradation
+ladder, serving journal, graceful drain, and the chaos soak.
+
+The acceptance contract (ISSUE 4): under a scripted mix of prefill/decode
+faults, an injected hang, and a mid-run drain + resume, every submitted
+request reaches a terminal Result (none lost), survivors are token-for-token
+greedy-parity with an uninterrupted run, and the breaker's
+closed -> open -> half-open -> closed cycle is visible in telemetry.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import (
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+)
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerBoard,
+    CircuitBreaker,
+    DegradationLadder,
+    GracefulDrain,
+    ServingJournal,
+    StepWatchdog,
+    resume_serving,
+)
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import ContinuousScheduler, Request
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.utils.failures import (
+    DecodeFault,
+    HangFault,
+    ScriptedFaultInjector,
+)
+
+
+def greedy(m: int) -> ModelSettings:
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+SCFG = ServingConfig(
+    enabled=True, num_slots=2, queue_capacity=64,
+    max_prompt_len=192, max_new_tokens=32, decode_chunk=4,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- watchdog -----------------------------------------------------------------
+
+
+def test_watchdog_under_budget_observes_quietly():
+    clock = FakeClock()
+    with use_registry() as reg:
+        wd = StepWatchdog(5.0, component="t", clock=clock)
+        wd.arm("decode")
+        clock.advance(1.0)
+        assert wd.observe("decode") == pytest.approx(1.0)
+        h = reg.histogram("step_wall_s", component="t", stage="decode")
+        assert h.count == 1 and h.max == pytest.approx(1.0)
+        assert reg.peek("watchdog_hangs_total", component="t",
+                        stage="decode") is None
+
+
+def test_watchdog_classifies_hang():
+    clock = FakeClock()
+    with use_registry() as reg:
+        wd = StepWatchdog(2.0, component="t", clock=clock)
+        wd.arm("decode")
+        clock.advance(3.0)
+        with pytest.raises(HangFault):
+            wd.observe("decode")
+        assert reg.counter("watchdog_hangs_total", component="t",
+                           stage="decode").value == 1
+
+
+def test_watchdog_injected_extra_seconds():
+    """The ScriptedFaultInjector hang mode: simulated stall seconds classify
+    a hang without any real time passing."""
+    with use_registry():
+        wd = StepWatchdog(1.0, component="t", clock=FakeClock())
+        wd.arm("decode")
+        with pytest.raises(HangFault):
+            wd.observe("decode", extra_s=3600.0)
+
+
+def test_watchdog_compile_exemption_and_injected_override():
+    """classify=False (first-use compile) records but never faults; an
+    INJECTED stall classifies even on an exempt step, so scripted chaos is
+    not masked by a compile."""
+    with use_registry() as reg:
+        wd = StepWatchdog(1.0, component="t", clock=FakeClock())
+        wd.observe("decode", elapsed=1e9, classify=False)  # no raise
+        assert reg.histogram("step_wall_s", component="t",
+                             stage="decode").count == 1
+        with pytest.raises(HangFault):
+            wd.observe("decode", elapsed=0.0, extra_s=3600.0, classify=False)
+
+
+def test_watchdog_disabled_threshold_still_records():
+    with use_registry() as reg:
+        wd = StepWatchdog(0.0, component="t", clock=FakeClock())
+        wd.arm("decode")
+        wd.observe("decode", extra_s=1e9)  # no classification when disabled
+        assert reg.histogram("step_wall_s", component="t",
+                             stage="decode").count == 1
+
+
+def test_watchdog_stalled_reads_liveness_gauge():
+    clock = FakeClock()
+    with use_registry() as reg:
+        wd = StepWatchdog(2.0, component="t", clock=clock)
+        # Observer-only path must not create the gauge just by looking.
+        assert wd.stalled() is None
+        assert reg.peek("step_last_completed_ts", component="t") is None
+        wd.arm("decode")
+        clock.advance(0.5)
+        wd.observe("decode")
+        assert wd.stalled() is None  # fresh
+        clock.advance(5.0)
+        assert wd.stalled() == pytest.approx(3.0)  # 5s idle - 2s budget
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_full_cycle():
+    clock = FakeClock()
+    with use_registry() as reg:
+        b = CircuitBreaker("decode", failure_threshold=2, cooldown_s=10.0,
+                           component="t", clock=clock)
+        assert b.allow() and b.state == CLOSED
+        b.record_failure()
+        assert b.state == CLOSED  # one short of the threshold
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.allow()  # cooldown not elapsed
+        assert b.seconds_until_probe == pytest.approx(10.0)
+        clock.advance(10.0)
+        assert b.allow()  # this call IS the half-open transition
+        assert b.state == HALF_OPEN
+        b.record_success()
+        assert b.state == CLOSED and b.consecutive_failures == 0
+        tr = lambda to: reg.counter(  # noqa: E731
+            "breaker_transitions_total", component="t", stage="decode", to=to
+        ).value
+        assert tr("open") == 1 and tr("half_open") == 1 and tr("closed") == 1
+
+
+def test_breaker_probe_failure_reopens():
+    clock = FakeClock()
+    with use_registry():
+        b = CircuitBreaker("prefill", failure_threshold=1, cooldown_s=5.0,
+                           component="t", clock=clock)
+        b.record_failure()
+        assert b.state == OPEN
+        clock.advance(5.0)
+        assert b.allow() and b.state == HALF_OPEN
+        b.record_failure()
+        assert b.state == OPEN  # probe failed: cooldown restarts
+        assert not b.allow()
+        clock.advance(4.9)
+        assert not b.allow()  # restarted, not resumed
+        clock.advance(0.2)
+        assert b.allow()
+
+
+def test_breaker_success_resets_consecutive_count():
+    with use_registry():
+        b = CircuitBreaker("decode", failure_threshold=3, component="t",
+                           clock=FakeClock())
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == CLOSED  # never 3 CONSECUTIVE
+
+
+def test_board_trips_drive_ladder():
+    clock = FakeClock()
+    with use_registry() as reg:
+        board = BreakerBoard(failure_threshold=1, cooldown_s=1.0,
+                             component="t", clock=clock)
+        assert board.ladder.level == 0
+        board.record_failure("decode")
+        assert board.state("decode") == OPEN and board.ladder.level == 1
+        board.record_failure("prefill")
+        assert board.ladder.level == 2
+        clock.advance(1.0)
+        assert board.allow("decode")  # half-open probe
+        board.record_success("decode")
+        assert board.state("decode") == CLOSED and board.ladder.level == 1
+        assert board.allow("prefill")
+        board.record_success("prefill")
+        assert board.ladder.level == 0
+        assert reg.gauge("degradation_level", component="t").value == 0
+
+
+def test_ladder_clamps_and_names_rungs():
+    with use_registry():
+        lad = DegradationLadder(component="t")
+        lad.retreat()
+        assert lad.level == 0  # clamped at the floor
+        for _ in range(10):
+            lad.advance()
+        assert lad.level == 3 and lad.rung == "static_fallback"
+
+
+# -- fault injector hang mode -------------------------------------------------
+
+
+def test_injector_hang_budget():
+    with use_registry():
+        inj = ScriptedFaultInjector(hangs={("r0", "decode"): 1},
+                                    hang_seconds=42.0)
+        assert inj.maybe_hang("r0", "prefill") == 0.0  # wrong stage
+        assert inj.maybe_hang("r0", "decode") == 42.0
+        assert inj.maybe_hang("r0", "decode") == 0.0  # budget spent
+        assert inj.hangs_fired == [("r0", "decode")]
+        inj.maybe_fail("r0", "decode")  # no fault budget: no raise
+
+
+# -- serving journal ----------------------------------------------------------
+
+
+def _spec_req(i, deadline=None):
+    return Request(prompt=f"prompt {i}", id=f"j{i}", settings=greedy(8),
+                   row_seed=1000 + i, deadline_s=deadline)
+
+
+def test_journal_roundtrip_and_unfinished(tmp_path):
+    j = ServingJournal(str(tmp_path))
+    for i in range(3):
+        j.record_submitted(_spec_req(i))
+    j.record_terminal("j1", "completed")
+    assert [r["id"] for r in j.unfinished()] == ["j0", "j2"]
+    reqs = j.to_requests()
+    assert [r.id for r in reqs] == ["j0", "j2"]
+    assert reqs[0].prompt == "prompt 0"
+    assert reqs[0].row_seed == 1000
+    assert reqs[0].settings == greedy(8)
+
+
+def test_journal_remaining_deadline_shrinks(tmp_path):
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(_spec_req(0, deadline=60.0))
+    # Backdate the ledger entry: 50 wall seconds already burned.
+    recs = j.records()
+    recs[0]["ts_unix"] -= 50.0
+    with open(j.path, "w") as f:
+        f.write(json.dumps(recs[0]) + "\n")
+    (req,) = j.to_requests()
+    assert req.deadline_s == pytest.approx(10.0, abs=1.0)
+    # A blown deadline resumes with 0 remaining (expired, not re-decoded).
+    recs[0]["ts_unix"] -= 100.0
+    with open(j.path, "w") as f:
+        f.write(json.dumps(recs[0]) + "\n")
+    (req,) = j.to_requests()
+    assert req.deadline_s == 0.0
+
+
+def test_journal_rotation_compacts_atomically(tmp_path):
+    j = ServingJournal(str(tmp_path), rotate_every=2)
+    for i in range(4):
+        j.record_submitted(_spec_req(i))
+    j.record_terminal("j0", "completed")
+    assert len(j.records()) == 5  # not rotated yet
+    j.record_terminal("j3", "failed")  # second terminal triggers compaction
+    recs = j.records()
+    assert [r["id"] for r in recs] == ["j1", "j2"]  # finished pairs dropped
+    assert all(r["kind"] == "submitted" for r in recs)
+    # The compacted journal stays appendable.
+    j.record_submitted(_spec_req(9))
+    assert [r["id"] for r in j.unfinished()] == ["j1", "j2", "j9"]
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(_spec_req(0))
+    j.close()
+    with open(j.path, "a") as f:
+        f.write('{"kind": "termi')  # killed mid-append
+    j2 = ServingJournal(str(tmp_path))
+    assert [r["id"] for r in j2.unfinished()] == ["j0"]
+
+
+# -- graceful drain -----------------------------------------------------------
+
+
+def test_graceful_drain_signal_sets_flag():
+    import signal
+
+    from fairness_llm_tpu.resilience import drain_requested
+
+    with use_registry():
+        assert not drain_requested()
+        with GracefulDrain(signals=(signal.SIGUSR1,)) as d:
+            assert not d.requested
+            signal.raise_signal(signal.SIGUSR1)
+            assert d.requested and drain_requested()
+        assert not drain_requested()  # uninstalled
+
+
+# -- scheduler integration ----------------------------------------------------
+
+
+# A GENEROUS watchdog budget: a real chunk on a loaded CPU harness can take
+# seconds (the first one includes XLA compilation), and these tests must
+# only ever classify the injector's SIMULATED stalls (hang_seconds=3600)
+# as hangs — never a legitimately slow step.
+RES = ResilienceConfig(enabled=True, max_step_seconds=120.0,
+                       breaker_threshold=1, breaker_cooldown_s=0.02,
+                       drain_grace_s=30.0)
+
+
+def test_scheduler_contains_injected_hang(engine):
+    """A watchdog-classified hang releases the whole chunk, requeues its
+    riders once, and the retry decodes to full greedy parity."""
+    inj = ScriptedFaultInjector(hangs={("hangme", "decode"): 1})
+    with use_registry() as reg:
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8), fault_injector=inj,
+            resilience=RES,
+        )
+        req = Request(prompt="the quick brown fox", id="hangme",
+                      settings=greedy(8))
+        (res,) = sched.serve([req])
+        assert res.ok and res.retries == 1
+        ref = engine.generate([req.prompt], req.settings)
+        np.testing.assert_array_equal(
+            res.tokens, ref.tokens[0][: len(res.tokens)]
+        )
+        assert reg.counter("watchdog_hangs_total", component="serving",
+                           stage="decode").value == 1
+        assert reg.counter("faults_total", component="serving", kind="hang",
+                           stage="decode").value == 1
+        assert reg.counter("serving_requeues_by_cause_total",
+                           component="serving", cause="hang").value == 1
+
+
+def test_scheduler_breaker_opens_and_recovers(engine):
+    """Threshold-1 breaker: one scripted decode fault opens it (stopping
+    decode until the cooldown), the half-open probe succeeds, and the run
+    completes — full cycle in the transition counters."""
+    inj = ScriptedFaultInjector({("flaky", "decode"): 1})
+    with use_registry() as reg:
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8), fault_injector=inj,
+            resilience=RES,
+        )
+        reqs = [Request(prompt=p, id=f"b{i}", settings=greedy(8))
+                for i, p in enumerate(["hello there", "one two three"])]
+        reqs.append(Request(prompt="fail fast", id="flaky",
+                            settings=greedy(8)))
+        results = sched.serve(reqs)
+        assert all(r.ok for r in results)
+        tr = lambda to: reg.counter(  # noqa: E731
+            "breaker_transitions_total", component="serving", stage="decode",
+            to=to,
+        ).value
+        assert tr("open") >= 1 and tr("half_open") >= 1 and tr("closed") >= 1
+        # the board is back to healthy by drain end
+        assert sched.breakers.state("decode") == CLOSED
+        assert sched.breakers.ladder.level == 0
+
+
+def test_degradation_rungs_apply_and_restore(engine):
+    """Rung effects on the real scheduler: 1 sheds speculation, 2 halves the
+    decode chunk + soft-caps the pool; retreat restores both."""
+    from fairness_llm_tpu.config import SpeculationConfig
+
+    with use_registry():
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    resilience=RES)
+        engine.speculation = SpeculationConfig(enabled=True)
+        try:
+            board = sched.breakers
+            board.ladder.advance()
+            sched._apply_degradation()
+            assert engine.speculation is None  # rung 1: shed
+            assert sched.decode_chunk == SCFG.decode_chunk
+            board.ladder.advance()
+            sched._apply_degradation()
+            assert sched.decode_chunk == SCFG.decode_chunk // 2
+            assert sched.live_cap == SCFG.num_slots // 2
+            board.ladder.retreat()
+            board.ladder.retreat()
+            sched._apply_degradation()
+            assert engine.speculation == SpeculationConfig(enabled=True)
+            assert sched.decode_chunk == SCFG.decode_chunk
+            assert sched.live_cap == SCFG.num_slots
+        finally:
+            engine.speculation = None
+
+
+def test_shared_engine_spec_shed_restore_idempotent(engine):
+    """Two schedulers sharing one engine + one board: the second shed must
+    not capture the already-shed None, and whichever scheduler applies the
+    retreat restores the ORIGINAL config (finding: a per-scheduler saved
+    copy restored None forever)."""
+    from fairness_llm_tpu.config import SpeculationConfig
+
+    with use_registry():
+        board = BreakerBoard(failure_threshold=1, cooldown_s=0.02)
+        a = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                resilience=RES, breakers=board)
+        b = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                resilience=RES, breakers=board)
+        original = SpeculationConfig(enabled=True)
+        engine.speculation = original
+        try:
+            board.ladder.advance()
+            a._apply_degradation()
+            assert engine.speculation is None
+            b._apply_degradation()  # must not re-save the shed None
+            board.ladder.retreat()
+            b._apply_degradation()  # B restores what A shed
+            assert engine.speculation == original
+            a._apply_degradation()  # and A's pass changes nothing
+            assert engine.speculation == original
+        finally:
+            engine.speculation = None
+            engine._spec_shed = False
+
+
+def test_static_fallback_probes_and_recovers(engine):
+    """Degradation level 3 must be RECOVERABLE: while breakers cool the
+    backend serves statically; once cooldowns elapse the next generate
+    falls through to the scheduler as the probe and the ladder retreats."""
+    from fairness_llm_tpu.serving import ServingBackend
+
+    import dataclasses
+
+    with use_registry():
+        # A LONG cooldown makes "still cooling" deterministic however slow
+        # the harness is; the elapse is then simulated by rewinding
+        # opened_at rather than sleeping.
+        backend = ServingBackend(
+            engine, SCFG,
+            resilience=dataclasses.replace(RES, breaker_cooldown_s=600.0),
+        )
+        board = backend.board
+        for stage in ("prefill", "decode", "speculate"):
+            board.record_failure(stage)
+        assert board.ladder.level == 3
+        prompts = ["hello there", "one two three"]
+        # Cooldowns not elapsed: static path (ladder stays pinned at 3).
+        texts1 = backend.generate(prompts, greedy(8), seed=0)
+        assert board.ladder.level == 3
+        for b in board.breakers.values():
+            b.opened_at -= 601.0  # cooldown "elapses"
+        # This call IS the probe — scheduler path, successes close
+        # prefill+decode, ladder walks down.
+        texts2 = backend.generate(prompts, greedy(8), seed=0)
+        assert board.state("prefill") == CLOSED
+        assert board.state("decode") == CLOSED
+        assert board.ladder.level == 1  # speculate still holds its rung
+        assert texts1 == texts2  # greedy parity across the two paths
+
+
+def test_fault_during_drain_grace_still_yields_results(engine, tmp_path):
+    """A fault DURING the drain-grace decode window requeues its victim
+    into the closed queue; the drain must sweep it into a preempted Result
+    (finding: it stranded with no Result and serve() raised KeyError)."""
+
+    class DrainThenFault(ScriptedFaultInjector):
+        """Requests a drain at the first decode consult, then faults 'g1'
+        on the SECOND consult — i.e. inside the grace loop."""
+
+        def __init__(self, sched_ref):
+            super().__init__()
+            self.sched_ref = sched_ref
+            self.consults = 0
+
+        def maybe_fail(self, request_id, stage):
+            if stage != "decode":
+                return
+            if self.consults == 0:
+                self.sched_ref[0].request_drain()
+            self.consults += 1
+            if request_id == "g1" and self.consults > 2:
+                self.fired.append((request_id, stage))
+                raise DecodeFault("injected grace-window fault for 'g1'")
+
+    with use_registry():
+        journal = ServingJournal(str(tmp_path))
+        sched_ref = []
+        inj = DrainThenFault(sched_ref)
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8), fault_injector=inj,
+            resilience=RES, journal=journal,
+        )
+        sched_ref.append(sched)
+        reqs = [Request(prompt="the quick brown fox", id="g0",
+                        settings=greedy(8)),
+                Request(prompt="hello there", id="g1", settings=greedy(8))]
+        results = {r.id: r for r in sched.serve(reqs)}  # must not KeyError
+        assert set(results) == {"g0", "g1"}
+        assert inj.fired, "the grace-window fault must have fired"
+        assert results["g1"].finish_reason == "preempted"
+        # The victim is journaled unfinished and resumable with parity.
+        assert [r["id"] for r in journal.unfinished()] == ["g1"]
+        resumed = resume_serving(engine, journal, serving=SCFG,
+                                 resilience=RES)
+        res = resumed["g1"]
+        assert res.ok
+        ref = engine.generate(["hello there"], greedy(8))
+        np.testing.assert_array_equal(
+            res.tokens, ref.tokens[0][: len(res.tokens)]
+        )
+
+
+def test_soft_cap_still_serves_everything(engine):
+    """With the pool soft-capped at 1 of 2 slots, the full workload still
+    completes (serially) with greedy parity."""
+    with use_registry():
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    resilience=RES)
+        sched.live_cap = 1
+        reqs = [Request(prompt=p, id=f"c{i}", settings=greedy(8))
+                for i, p in enumerate(["hi", "abc abc abc", "zz"])]
+        results = sched.serve(reqs)
+        for req, res in zip(reqs, results):
+            assert res.ok
+            ref = engine.generate([req.prompt], req.settings)
+            np.testing.assert_array_equal(
+                res.tokens, ref.tokens[0][: len(res.tokens)]
+            )
+
+
+def test_drain_preempts_and_resume_finishes(engine, tmp_path):
+    """Mid-run drain: requests still queued preempt to the journal; a fresh
+    resume_serving finishes them with greedy parity and empties the
+    journal."""
+
+    class DrainOnSight(ScriptedFaultInjector):
+        def __init__(self, sched_ref, trigger_id):
+            super().__init__()
+            self.sched_ref = sched_ref
+            self.trigger_id = trigger_id
+
+        def maybe_fail(self, request_id, stage):
+            # First decode consult of the trigger request: ask for a drain —
+            # deterministic "SIGTERM arrived mid-run".
+            if request_id == self.trigger_id and stage == "decode":
+                self.sched_ref[0].request_drain()
+            super().maybe_fail(request_id, stage)
+
+    with use_registry():
+        journal = ServingJournal(str(tmp_path))
+        sched_ref = []
+        inj = DrainOnSight(sched_ref, "d0")
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8), fault_injector=inj,
+            resilience=RES, journal=journal,
+        )
+        sched_ref.append(sched)
+        prompts = ["the quick brown fox", "hi", "abc abc abc abc",
+                   "one two three", "recommend ten films please"]
+        reqs = [Request(prompt=p, id=f"d{i}", settings=greedy(8))
+                for i, p in enumerate(prompts)]
+        results = sched.serve(reqs)
+        by_reason = {}
+        for r in results:
+            by_reason.setdefault(r.finish_reason, []).append(r.id)
+        assert by_reason.get("preempted"), "drain must preempt something"
+        assert sched.last_stats.preempted == len(by_reason["preempted"])
+        # Journal holds exactly the preempted set, unfinished.
+        assert sorted(r["id"] for r in journal.unfinished()) == \
+            sorted(by_reason["preempted"])
+        # Resume in a "successor process" (fresh scheduler, same journal).
+        resumed = resume_serving(engine, journal, serving=SCFG,
+                                 resilience=RES)
+        assert sorted(resumed) == sorted(by_reason["preempted"])
+        for req in reqs:
+            res = resumed.get(req.id) or next(
+                r for r in results if r.id == req.id
+            )
+            assert res.ok, (req.id, res.error)
+            ref = engine.generate([req.prompt], req.settings)
+            np.testing.assert_array_equal(
+                res.tokens, ref.tokens[0][: len(res.tokens)]
+            )
+        assert journal.unfinished() == []
+
+
+# -- the chaos soak -----------------------------------------------------------
+
+
+def test_chaos_soak_faults_hang_drain_resume(engine, tmp_path):
+    """The ISSUE-4 acceptance run: scripted prefill+decode faults (one
+    transient, one permanent), one injected hang, a mid-run drain, then
+    resume — every request terminal, survivors greedy-parity, breaker
+    closed -> open -> half-open -> closed visible in the snapshot."""
+    from fairness_llm_tpu.telemetry import snapshot
+
+    prompts = {
+        "ok0": "the quick brown fox",
+        "flaky": "hello there friend",
+        "doomed": "abc abc abc abc abc",
+        "pfault": "one two three one two",
+        "hangme": "recommend ten films please",
+        "late0": "zz zz zz",
+        "late1": "a long prompt that shifts padding and lands in a bucket",
+    }
+
+    class DrainAfter(ScriptedFaultInjector):
+        """Requests a drain the first time a LATE request reaches decode —
+        by then the early cohort has churned through fault/hang/recovery."""
+
+        def __init__(self, faults, hangs, sched_ref):
+            super().__init__(faults, hangs=hangs)
+            self.sched_ref = sched_ref
+
+        def maybe_fail(self, request_id, stage):
+            if request_id == "late0" and stage == "decode":
+                self.sched_ref[0].request_drain()
+            super().maybe_fail(request_id, stage)
+
+    with use_registry() as reg:
+        journal = ServingJournal(str(tmp_path))
+        sched_ref = []
+        inj = DrainAfter(
+            faults={("flaky", "decode"): 1,   # transient: requeue + succeed
+                    ("doomed", "decode"): 2,  # permanent: requeue + fail
+                    ("pfault", "prefill"): 1},
+            hangs={("hangme", "decode"): 1},  # one injected hang
+            sched_ref=sched_ref,
+        )
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8), fault_injector=inj,
+            resilience=RES, journal=journal,
+        )
+        sched_ref.append(sched)
+        reqs = [Request(prompt=p, id=rid, settings=greedy(8))
+                for rid, p in prompts.items()]
+        results = {r.id: r for r in sched.serve(reqs)}
+
+        # Phase 1 invariants: everything terminal, the permanent fault
+        # failed, nothing silently lost.
+        assert set(results) == set(prompts)
+        assert results["doomed"].finish_reason == "failed"
+        assert results["doomed"].retries == 1
+        preempted = [rid for rid, r in results.items()
+                     if r.finish_reason == "preempted"]
+        assert preempted, "the drain must have caught the late cohort"
+        assert "doomed" not in preempted
+        # "pfault" may legitimately fail: its one requeue went to the
+        # scripted prefill fault, and if it then shares the hung decode
+        # chunk with "hangme" the hang's whole-chunk blast radius is its
+        # SECOND contained fault — requeue-once semantics say that
+        # terminates failed, which is a terminal outcome, not a loss.
+        must_succeed = set(prompts) - {"doomed", "pfault"}
+
+        # Resume the journal in a fresh scheduler ("successor process").
+        resumed = resume_serving(engine, journal, serving=SCFG,
+                                 resilience=RES)
+        assert sorted(resumed) == sorted(preempted)
+        assert journal.unfinished() == []
+
+        # Zero lost: every request has exactly one terminal outcome across
+        # the two runs, and every survivor is token-for-token greedy parity
+        # with the uninterrupted engine.
+        final = {**results, **resumed}
+        for rid, prompt in prompts.items():
+            res = final[rid]
+            if rid == "doomed":
+                assert not res.ok
+                continue
+            if rid not in must_succeed and not res.ok:
+                assert res.finish_reason == "failed"  # terminal, not lost
+                continue
+            assert res.ok, (rid, res.finish_reason, res.error)
+            ref = engine.generate([prompt], greedy(8))
+            np.testing.assert_array_equal(
+                res.tokens, ref.tokens[0][: len(res.tokens)]
+            )
+            pad = engine.tokenizer.pad_id
+            assert np.all(ref.tokens[0][len(res.tokens):] == pad)
+
+        # The breaker walked its full cycle and telemetry can prove it.
+        snap = snapshot(reg)
+        tr = {
+            (c["labels"]["stage"], c["labels"]["to"]): c["value"]
+            for c in snap["counters"]
+            if c["name"] == "breaker_transitions_total"
+        }
+        assert tr.get(("decode", "open"), 0) >= 1
+        assert tr.get(("decode", "half_open"), 0) >= 1
+        assert tr.get(("decode", "closed"), 0) >= 1
+        counters = {
+            (c["name"],) + tuple(sorted(c["labels"].items())): c["value"]
+            for c in snap["counters"]
+        }
+        assert reg.counter("watchdog_hangs_total", component="serving",
+                           stage="decode").value == 1
+        assert reg.counter("serving_preempted_total",
+                           component="serving").value == len(preempted)
+        # Healthy again: breakers closed, ladder fully retreated.
+        assert sched.breakers.state("decode") == CLOSED
+        assert sched.breakers.state("prefill") == CLOSED
+        assert sched.breakers.ladder.level == 0
+        assert counters  # snapshot non-degenerate

@@ -383,6 +383,41 @@ def test_scheduler_deadline_in_queue_and_mid_decode(engine):
     assert res.ok and res.finish_reason == "length"
 
 
+def test_deadline_expiry_races_requeue_window(engine):
+    """A request whose deadline passes while it sits in the requeue-after-
+    fault window must terminate ``expired`` — never spend a prefill on a
+    second attempt. The racy window is a PREFILL fault: the requeue lands
+    at the queue front while ``_admit`` is still looping, so the very next
+    pop would re-admit it with no deadline check between (the queue's
+    expiry sweep only runs at iteration start)."""
+    reqs = {}
+
+    class ExpireOnFault(ScriptedFaultInjector):
+        def maybe_fail(self, request_id, stage):
+            try:
+                super().maybe_fail(request_id, stage)
+            except DecodeFault:
+                # Deterministic race: the deadline elapses during the fault
+                # handling, before the requeue is popped again.
+                reqs[request_id].deadline_s = 0.0
+                raise
+
+    inj = ExpireOnFault({("racy", "prefill"): 1})
+    sched = ContinuousScheduler(
+        engine, SCFG, settings=greedy(8), fault_injector=inj
+    )
+    r = _req("hello there", m=8, id="racy", deadline_s=300.0)
+    reqs[r.id] = r
+    res = sched.serve([r, _req("world", m=8, id="ok")])
+    by_id = {x.id: x for x in res}
+    assert by_id["ok"].ok
+    racy = by_id["racy"]
+    assert not racy.ok and racy.finish_reason == "deadline"
+    assert len(racy.tokens) == 0  # no second decode attempt
+    assert inj.fired == [("racy", "prefill")]  # one fault, no re-prefill
+    assert sched.last_stats.expired == 1
+
+
 # -- fault containment -------------------------------------------------------
 
 

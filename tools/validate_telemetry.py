@@ -5,12 +5,15 @@ and nonzero.
 Usage:
     python tools/validate_telemetry.py <telemetry-dir-or-snapshot.json>
     python tools/validate_telemetry.py <path> --require-serving
+    python tools/validate_telemetry.py <path> --require-breaker
 
 Plain mode checks the schema only (`cli telemetry-report --validate` does
 the same inline). ``--require-serving`` additionally requires nonzero TTFT,
 queue-wait, and per-output-token histograms with p50 <= p95 <= p99 <= max —
 the CI smoke step's gate after a ``--continuous --telemetry-dir`` run of the
-tiny CPU study.
+tiny CPU study. ``--require-breaker`` requires the resilience signals the
+chaos smoke step produces: breaker_state gauges, a full
+closed->open->half-open->closed transition cycle, and a counted hang.
 """
 
 from __future__ import annotations
@@ -26,9 +29,29 @@ from fairness_llm_tpu.telemetry import load_snapshot, validate_snapshot  # noqa:
 REQUIRED_SERVING_HISTOGRAMS = ("ttft_s", "queue_wait_s", "per_output_token_s")
 
 
-def check(path: str, require_serving: bool = False) -> int:
+def check(path: str, require_serving: bool = False,
+          require_breaker: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
+    if require_breaker:
+        gauges = [g for g in snap.get("gauges", [])
+                  if g.get("name") == "breaker_state"]
+        if not gauges:
+            problems.append("no breaker_state gauges (resilience not armed?)")
+        trans = {
+            (c["labels"].get("stage"), c["labels"].get("to")): c["value"]
+            for c in snap.get("counters", [])
+            if c.get("name") == "breaker_transitions_total"
+        }
+        for to in ("open", "half_open", "closed"):
+            if not any(v for (stage, t), v in trans.items() if t == to):
+                problems.append(
+                    f"no breaker transition to={to} (cycle incomplete)"
+                )
+        hangs = [c for c in snap.get("counters", [])
+                 if c.get("name") == "watchdog_hangs_total" and c["value"]]
+        if not hangs:
+            problems.append("watchdog_hangs_total is zero (no hang counted)")
     if require_serving:
         hists = {
             h["name"]: h
@@ -60,8 +83,10 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path")
     ap.add_argument("--require-serving", action="store_true")
+    ap.add_argument("--require-breaker", action="store_true")
     a = ap.parse_args()
-    return check(a.path, require_serving=a.require_serving)
+    return check(a.path, require_serving=a.require_serving,
+                 require_breaker=a.require_breaker)
 
 
 if __name__ == "__main__":
