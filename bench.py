@@ -363,6 +363,76 @@ def measure_resilience_overhead(engine, prompts, settings_cls) -> dict | None:
     return out
 
 
+def measure_integrity_overhead(engine, prompts, settings_cls) -> dict | None:
+    """Fault-free continuous serving with the numerics guards off vs on.
+
+    The guard is one ``isfinite`` + AND-reduction over the step's logits
+    folded INTO the compiled program (integrity/numerics.py) — device-side
+    work this time, unlike the resilience guard's host-side bookkeeping, so
+    the A/B compiles two distinct step programs and measures whether the
+    reduction is visible over the decode loop's weight/KV streaming. The
+    ISSUE-5 target is the same as ISSUE-4's: within the CPU harness's
+    run-to-run noise (best-of-N per mode in one process).
+
+    Same mixed-length workload shape as ``measure_continuous`` (constant
+    admission churn = maximum prefill+decode program launches per token,
+    i.e. maximum guard evaluations per token)."""
+    from fairness_llm_tpu.config import ServingConfig, default_config
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+
+    num_slots = max(default_config().decode_batch_size, 1)
+    n_requests = 2 * num_slots
+    budgets = [16, 32, 48, 64]
+    workload = _mixed_workload(engine, prompts, n_requests,
+                               targets=[32, 64, 128, 256], budgets=budgets)
+
+    def greedy(m):
+        return _greedy(settings_cls, m)
+
+    scfg = ServingConfig(
+        enabled=True, num_slots=num_slots, max_prompt_len=512,
+        max_new_tokens=max(budgets), decode_chunk=8,
+    )
+
+    def run(sched, tag):
+        reqs = [
+            Request(prompt=p, id=f"integ_{tag}_{i:04d}", settings=greedy(b))
+            for i, (p, b) in enumerate(workload)
+        ]
+        t0 = time.perf_counter()
+        results = sched.serve(reqs)
+        wall = time.perf_counter() - t0
+        assert all(r.ok for r in results)
+        return wall, sum(len(r.tokens) for r in results), results
+
+    prev_guard = engine.numerics_guards
+    out = {}
+    tokens = {}
+    try:
+        for tag, guard in (("off", False), ("on", True)):
+            engine.numerics_guards = guard
+            sched = ContinuousScheduler(engine, scfg,
+                                        settings=greedy(max(budgets)))
+            run(sched, tag)  # warmup: compile prefill buckets + step program
+            (wall, toks, results) = min(
+                (run(sched, tag) for _ in range(3)), key=lambda r: r[0]
+            )
+            tokens[tag] = [tuple(int(t) for t in r.tokens) for r in results]
+            out[tag] = {
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": round(toks / wall, 1),
+            }
+    finally:
+        engine.numerics_guards = prev_guard
+    # The guard must never change the tokens — parity is part of the guard's
+    # contract, so the bench asserts it on the workload it just decoded.
+    assert tokens["on"] == tokens["off"], "numerics guard changed output"
+    out["overhead_ratio"] = round(
+        out["on"]["wall_s"] / out["off"]["wall_s"], 3
+    )
+    return out
+
+
 def measure_achievable_gbps() -> float | None:
     """This chip's ACHIEVABLE streaming bandwidth, measured in-run.
 
@@ -909,6 +979,16 @@ def _run() -> None:
         print(f"resilience overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Integrity overhead guard (ISSUE 5): fault-free continuous serving
+    # with the on-device numerics guards off vs on — the in-program finite
+    # reduction must stay within harness noise, and the tokens identical.
+    integrity = None
+    try:
+        integrity = measure_integrity_overhead(engine, prompts, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"integrity overhead A/B skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -1236,6 +1316,7 @@ def _run() -> None:
             "speculative": speculative,
             "continuous": continuous,
             "resilience_overhead": resilience,
+            "integrity_overhead": integrity,
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
             "large_sweep_int8w_int8kv": large_sweep_int8w,

@@ -155,6 +155,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --serving-journal: seconds live slots may "
                         "keep decoding after a drain signal before being "
                         "journaled as unfinished (default 5)")
+    p.add_argument("--numerics-guards", action="store_true",
+                   help="integrity: fold an on-device finite check of the "
+                        "logits into every compiled decode program (one "
+                        "reduced flag per chunk); NaN/Inf chunks are "
+                        "contained as NumericsFault instead of silently "
+                        "decoding garbage. Output is token-for-token "
+                        "identical either way. See docs/RESILIENCE.md")
+    p.add_argument("--canary-every", type=int, default=None, metavar="N",
+                   help="with --continuous: every N backend calls, decode a "
+                        "golden prompt through the live scheduler and "
+                        "compare token-for-token against a static-engine "
+                        "reference; a mismatch trips the breaker "
+                        "degradation ladder")
+    p.add_argument("--no-verify-manifests", action="store_true",
+                   help="skip sha256 manifest verification of weight "
+                        "checkpoints at load (on by default where a "
+                        "manifest.json exists)")
     p.add_argument("--mesh", default=None, help="device mesh, e.g. 'dp=2,tp=4'")
     p.add_argument("--weights-dir", default=None, help="directory of HF safetensors checkpoints")
     p.add_argument("--weight-quant", default=None, choices=("none", "int8"),
@@ -262,6 +279,23 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--drain-grace must be >= 0")
             res_kwargs["drain_grace_s"] = args.drain_grace
         updates["resilience"] = ResilienceConfig(**res_kwargs)
+    if args.numerics_guards or args.canary_every is not None \
+            or args.no_verify_manifests:
+        from fairness_llm_tpu.config import IntegrityConfig
+
+        integ_kwargs: Dict = {}
+        if args.numerics_guards:
+            integ_kwargs["numerics_guards"] = True
+        if args.canary_every is not None:
+            if not args.continuous:
+                raise SystemExit("--canary-every requires --continuous (the "
+                                 "canary probes the serving scheduler)")
+            if args.canary_every < 1:
+                raise SystemExit("--canary-every must be >= 1")
+            integ_kwargs["canary_every_n"] = args.canary_every
+        if args.no_verify_manifests:
+            integ_kwargs["verify_manifests"] = False
+        updates["integrity"] = IntegrityConfig(**integ_kwargs)
     if updates:
         config = dataclasses.replace(config, **updates)
     return config

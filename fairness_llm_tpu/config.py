@@ -113,6 +113,35 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IntegrityConfig:
+    """Silent-corruption detection knobs (``integrity/``).
+
+    ``numerics_guards`` folds an on-device finite check of the logits into
+    every compiled prefill/decode/speculative program — one AND-reduced
+    flag per chunk, no host sync per token; a tripped flag is contained as
+    a ``NumericsFault`` (requeue-once / chunk-retry, breaker-visible). Off
+    by default: guarded programs compile under their own keys and the
+    token stream is identical either way, so flipping it is always safe.
+
+    ``verify_manifests`` (default ON) checks the sha256 ``manifest.json``
+    beside weight checkpoints at load when one exists — a corrupt shard is
+    refused with an error naming the file. Artifacts without a manifest
+    load as before.
+
+    ``canary_every_n`` > 0 arms the serving canary: every N backend
+    ``generate`` calls, a golden prompt decodes through the live scheduler
+    and is compared token-for-token against a reference recorded from the
+    static engine; a mismatch trips the decode breaker (and with it the
+    degradation ladder). See docs/RESILIENCE.md §Integrity.
+    """
+
+    numerics_guards: bool = False
+    verify_manifests: bool = True
+    canary_every_n: int = 0  # 0 = canary off
+    canary_max_tokens: int = 16
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes follow the scaling-book convention:
 
@@ -215,6 +244,12 @@ class Config:
     # and friends flip it on). See docs/RESILIENCE.md.
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
+    )
+    # Integrity: numerics guards + manifest verification + serving canary
+    # (guards/canary off by default; manifest verification on — it only
+    # applies where a manifest exists). See docs/RESILIENCE.md §Integrity.
+    integrity: IntegrityConfig = dataclasses.field(
+        default_factory=IntegrityConfig
     )
 
     def settings_for(self, model_name: str) -> ModelSettings:

@@ -1,0 +1,63 @@
+"""Integrity subsystem: silent-corruption detection end to end.
+
+The resilience package (``resilience/``) catches faults that *announce
+themselves* — hangs, raised exceptions, SIGTERM. Nothing below this package
+catches faults that produce *wrong numbers*: NaN/Inf-poisoned logits silently
+argmax to token 0, a bit-flipped or truncated weight shard loads without
+complaint, and a corrupt checkpoint resumes into a garbage fairness report.
+For a fairness-measurement pipeline that is the worst failure mode — a wrong
+report looks exactly like a right one.
+
+Three detectors, one per corruption shape:
+
+- ``numerics``  — a cheap on-device finite check folded into every compiled
+  prefill/decode/speculative program (one AND-reduced flag per chunk; the
+  host reads it alongside the tokens it already fetches, so there is no
+  extra sync per token). A tripped flag raises ``NumericsFault`` — a
+  ``DecodeFault`` subclass, so slot-requeue / chunk-retry / breaker
+  containment absorbs it with zero new plumbing.
+- ``manifest``  — sha256 manifests written beside weights, train
+  checkpoints, and phase results; verified on load. A bad digest refuses the
+  artifact with an :class:`IntegrityError` naming the file (weights) or
+  falls back to the next-older valid checkpoint (train/results resume).
+- ``canary``    — a periodic golden-prompt decode through the live serving
+  scheduler, compared token-for-token against a recorded reference; a
+  mismatch is *wrong-but-finite* output no numeric check can see, and trips
+  the breaker degradation ladder.
+
+All of it is drillable on the CPU harness: ``ScriptedFaultInjector``
+(``utils/failures.py``) gained NaN-injection and bit-flip modes, and
+``tools/chaos_drill.py`` exercises every detector. See docs/RESILIENCE.md
+§Integrity for the fault-model table.
+"""
+
+from fairness_llm_tpu.integrity.canary import DEFAULT_CANARY_PROMPT, CanaryProbe
+from fairness_llm_tpu.integrity.manifest import (
+    MANIFEST_FILENAME,
+    IntegrityError,
+    build_manifest,
+    maybe_verify_manifest,
+    update_manifest_entry,
+    verify_manifest,
+    verify_manifest_entry,
+    write_manifest,
+)
+from fairness_llm_tpu.integrity.numerics import (
+    check_finite,
+    masked_finite,
+)
+
+__all__ = [
+    "build_manifest",
+    "CanaryProbe",
+    "check_finite",
+    "DEFAULT_CANARY_PROMPT",
+    "IntegrityError",
+    "MANIFEST_FILENAME",
+    "masked_finite",
+    "maybe_verify_manifest",
+    "update_manifest_entry",
+    "verify_manifest",
+    "verify_manifest_entry",
+    "write_manifest",
+]

@@ -1,0 +1,158 @@
+"""Canary probe: catch *wrong-but-finite* output in the live serving path.
+
+The numerics guards (``integrity/numerics.py``) catch NaN/Inf poisoning and
+the manifests (``integrity/manifest.py``) catch corrupt bytes at rest — but
+a serving stack can also go wrong while every number stays finite: a stale
+compiled program after a botched degradation transition, a KV slot leaking a
+previous tenant's keys, a miscompiled kernel on one chip of a fleet. The
+only detector for that class is end-to-end: decode a GOLDEN PROMPT through
+the live scheduler and compare token-for-token against a reference recorded
+from the static engine — the numerically-reference program the serving
+parity contract is defined against (docs/SERVING.md).
+
+``CanaryProbe`` is that comparison, packaged for the ``ServingBackend``:
+
+- ``record()`` decodes the golden prompt once through the static engine and
+  pins the expected tokens (greedy — the deterministic regime the parity
+  contract covers).
+- ``tick()`` counts backend ``generate`` calls; every ``every_n``-th call
+  is due a probe.
+- ``probe(scheduler)`` serves the golden request through the live scheduler
+  and compares. A mismatch counts ``canary_mismatch_total``, emits a
+  ``canary_mismatch`` event, and TRIPS the decode breaker open — driving
+  the existing degradation ladder (shed speculation → shrink footprint →
+  static-engine fallback) through the same machinery every other fault
+  uses, and recovering the same way: the breaker's half-open probe.
+
+The probe costs one ``num_slots``-pooled greedy decode of
+``canary_max_tokens`` tokens per ``every_n`` calls; with guards/canary off
+the serving path is byte-identical (pinned in tests/test_integrity.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from fairness_llm_tpu.config import ModelSettings
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CANARY_PROMPT = (
+    "List ten classic films, one per line, numbered 1 through 10."
+)
+
+
+class CanaryProbe:
+    def __init__(
+        self,
+        prompt: str,
+        reference_tokens: np.ndarray,
+        settings: ModelSettings,
+        pad_id: int,
+        every_n: int = 32,
+        board=None,
+        component: str = "serving",
+    ):
+        self.prompt = prompt
+        # The full engine token row ([max_new], pad-filled after EOS): the
+        # serving result must be a prefix of it with only pads beyond.
+        self.reference = np.asarray(reference_tokens)
+        self.settings = settings
+        self.pad_id = pad_id
+        self.every_n = int(every_n)
+        self.board = board
+        self.component = component
+        self._calls = 0
+        self._seq = 0
+        # Gauge exists from construction: a healthy snapshot still shows
+        # the canary was armed (1 ok / 0 mismatch / -1 never probed).
+        get_registry().gauge(
+            "canary_last_ok", component=component
+        ).set(-1)
+
+    @classmethod
+    def record(
+        cls,
+        engine,
+        prompt: str = DEFAULT_CANARY_PROMPT,
+        max_tokens: int = 16,
+        every_n: int = 32,
+        board=None,
+        component: str = "serving",
+    ) -> "CanaryProbe":
+        """Pin the reference by decoding the golden prompt through the
+        static engine — greedy, no shared prefix (the serving scheduler
+        decodes rows independently, so the parity target must too)."""
+        settings = ModelSettings(temperature=0.0, max_tokens=max_tokens)
+        out = engine.generate([prompt], settings, share_prefix=False)
+        return cls(
+            prompt, out.tokens[0], settings, engine.tokenizer.pad_id,
+            every_n=every_n, board=board, component=component,
+        )
+
+    def tick(self) -> bool:
+        """Count one backend call; True when a probe is due."""
+        self._calls += 1
+        return self.every_n > 0 and self._calls % self.every_n == 0
+
+    def probe(self, scheduler) -> bool:
+        """Serve the golden request through ``scheduler`` and compare.
+        Returns True on token-for-token match; a mismatch trips the decode
+        breaker (and with it the degradation ladder)."""
+        from fairness_llm_tpu.serving.request import Request
+
+        self._seq += 1
+        req = Request(
+            prompt=self.prompt, id=f"__canary_{self._seq}__",
+            settings=self.settings, row_seed=0,
+        )
+        res = scheduler.serve([req])[0]
+        got = np.asarray(res.tokens)
+        n = len(got)
+        ok = bool(
+            res.ok
+            and n > 0
+            and n <= len(self.reference)
+            and np.array_equal(got, self.reference[:n])
+            and np.all(self.reference[n:] == self.pad_id)
+        )
+        reg = get_registry()
+        reg.counter("canary_runs_total", component=self.component).inc()
+        reg.gauge("canary_last_ok", component=self.component).set(1 if ok else 0)
+        if ok:
+            return True
+        reg.counter("canary_mismatch_total", component=self.component).inc()
+        emit_event(
+            "canary_mismatch", component=self.component,
+            finish_reason=res.finish_reason,
+            got=[int(t) for t in got[:8]],
+            expected=[int(t) for t in self.reference[:8]],
+        )
+        logger.error(
+            "canary mismatch: golden prompt decoded %s (expected prefix of "
+            "%s, finish_reason=%s) — serving output is silently wrong",
+            [int(t) for t in got[:8]],
+            [int(t) for t in self.reference[:8]], res.finish_reason,
+        )
+        self._trip_breaker()
+        return False
+
+    def _trip_breaker(self) -> None:
+        """Force the decode breaker open: a canary mismatch is direct
+        evidence the decode path produces wrong output, so it spends the
+        whole failure budget at once. Recovery stays the breaker's own
+        half-open probe — the ladder walks back down when real traffic (or
+        the next canary) decodes correctly again."""
+        if self.board is None:
+            return
+        breaker = self.board.breakers.get("decode")
+        if breaker is None:
+            return
+        from fairness_llm_tpu.resilience.breaker import OPEN
+
+        while breaker.state != OPEN:
+            breaker.record_failure()

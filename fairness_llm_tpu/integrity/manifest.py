@@ -1,0 +1,257 @@
+"""Verified artifacts: sha256 manifests beside weights, checkpoints, results.
+
+A truncated download, a bit-flipped block, or a torn write turns a weight
+shard or a results checkpoint into an artifact that *loads fine* and
+*computes garbage* — safetensors validates its own header but not the tensor
+bytes, JSON parses any prefix-intact file, and orbax trusts the filesystem.
+The manifest closes that gap: every producer writes ``manifest.json`` beside
+its files (per-file sha256 + byte size, plus a tensor shape/dtype summary
+for safetensors shards), and every loader verifies before trusting.
+
+Two verification disciplines, matched to the loader's fallback options:
+
+- **Refuse** (weights, ``runtime/weights.py``): there is no older copy of a
+  checkpoint directory to fall back to, so a bad digest raises
+  :class:`IntegrityError` naming the offending file — loudly, before a
+  single tensor reaches the device.
+- **Fall back** (train checkpoints, phase-results resume): the loaders
+  already walk newest-to-oldest past unreadable files;
+  ``verify_manifest_entry`` adds "digest mismatch" to the reasons a
+  checkpoint is skipped, so resume degrades to the next-older valid state
+  instead of resuming garbage.
+
+Both paths count ``manifest_verifications_total{kind}`` /
+``manifest_failures_total{kind}`` and emit a ``manifest_failure`` event, so
+a chaos drill (or a real incident) is visible in the telemetry snapshot.
+
+Manifests are optional by construction: a directory without one verifies
+trivially (pre-manifest artifacts keep loading), and files present on disk
+but absent from the manifest are ignored (tokenizers and provenance notes
+can be added without re-manifesting).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import struct
+from typing import Dict, Optional, Sequence
+
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+
+logger = logging.getLogger(__name__)
+
+MANIFEST_FILENAME = "manifest.json"
+MANIFEST_VERSION = 1
+_HASH_CHUNK = 1 << 20
+
+
+class IntegrityError(RuntimeError):
+    """An artifact failed its manifest check (digest/size mismatch or a
+    listed file missing). The message names the file — that is the contract
+    drills and operators rely on."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_HASH_CHUNK)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _safetensors_summary(path: str) -> Optional[Dict]:
+    """Shape/dtype summary from a safetensors header (pure struct+json —
+    no safetensors dependency, and no tensor bytes read). None when the
+    header doesn't parse; the digest still covers the whole file."""
+    try:
+        with open(path, "rb") as f:
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            if hlen > 100 << 20:  # a sane header is KBs; refuse absurdity
+                return None
+            header = json.loads(f.read(hlen))
+    except (OSError, ValueError, struct.error):
+        return None
+    tensors = {
+        name: {"dtype": meta.get("dtype"), "shape": meta.get("shape")}
+        for name, meta in header.items()
+        if name != "__metadata__" and isinstance(meta, dict)
+    }
+    return {"num_tensors": len(tensors), "tensors": tensors}
+
+
+def _file_entry(root: str, rel: str) -> Dict:
+    path = os.path.join(root, rel)
+    entry: Dict = {
+        "sha256": _sha256_file(path),
+        "bytes": os.path.getsize(path),
+    }
+    if rel.endswith(".safetensors"):
+        summary = _safetensors_summary(path)
+        if summary is not None:
+            entry.update(summary)
+    return entry
+
+
+def build_manifest(root: str, files: Optional[Sequence[str]] = None) -> Dict:
+    """Manifest dict for ``files`` (relative paths; default: every regular
+    file under ``root``, recursively, except the manifest itself)."""
+    if files is None:
+        files = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fname in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, fname), root)
+                if rel != MANIFEST_FILENAME:
+                    files.append(rel)
+        files.sort()
+    return {
+        "version": MANIFEST_VERSION,
+        "files": {rel: _file_entry(root, rel) for rel in files},
+    }
+
+
+def _write_json_atomic(payload: Dict, path: str) -> None:
+    # Same tmp+fsync+replace discipline as pipeline/results.py: a manifest
+    # that can be torn is worse than none (it would refuse good artifacts).
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True, allow_nan=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_manifest(
+    root: str,
+    files: Optional[Sequence[str]] = None,
+    path: Optional[str] = None,
+) -> str:
+    """Build and atomically write a manifest for ``root``; returns its path
+    (default ``root/manifest.json``; ``path`` relocates it, e.g. the train
+    checkpointer keeps manifests OUTSIDE orbax's step directories)."""
+    path = path or os.path.join(root, MANIFEST_FILENAME)
+    _write_json_atomic(build_manifest(root, files), path)
+    logger.debug("wrote manifest %s", path)
+    return path
+
+
+def _load_manifest(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable manifest %s: %s", path, e)
+        return None
+    return data if isinstance(data, dict) and isinstance(data.get("files"), dict) else None
+
+
+def _check_entry(root: str, rel: str, entry: Dict) -> Optional[str]:
+    """None when the file matches its manifest entry; else a human-readable
+    reason (always naming the file)."""
+    path = os.path.join(root, rel)
+    if not os.path.exists(path):
+        return f"{rel}: listed in manifest but missing on disk"
+    size = os.path.getsize(path)
+    want_bytes = entry.get("bytes")
+    if want_bytes is not None and size != want_bytes:
+        return f"{rel}: size {size} != manifest {want_bytes} (truncated?)"
+    digest = _sha256_file(path)
+    want = entry.get("sha256")
+    if want is not None and digest != want:
+        return f"{rel}: sha256 {digest[:16]}… != manifest {want[:16]}… (corrupt)"
+    return None
+
+
+def verify_manifest(
+    root: str,
+    manifest_path: Optional[str] = None,
+    kind: str = "artifact",
+) -> None:
+    """Verify every file the manifest lists; raises :class:`IntegrityError`
+    on the first mismatch (refuse discipline — used for weights, where no
+    older copy exists to fall back to)."""
+    manifest_path = manifest_path or os.path.join(root, MANIFEST_FILENAME)
+    manifest = _load_manifest(manifest_path)
+    reg = get_registry()
+    reg.counter("manifest_verifications_total", kind=kind).inc()
+    if manifest is None:
+        _fail(kind, manifest_path, "manifest unreadable or malformed")
+    for rel in sorted(manifest["files"]):
+        problem = _check_entry(root, rel, manifest["files"][rel])
+        if problem is not None:
+            _fail(kind, os.path.join(root, rel), problem)
+    logger.info(
+        "manifest OK: %s (%d file(s) verified)", root, len(manifest["files"])
+    )
+
+
+def _fail(kind: str, path: str, problem: str) -> None:
+    get_registry().counter("manifest_failures_total", kind=kind).inc()
+    # "artifact_kind", not "kind": emit_event's first positional is the
+    # EVENT kind.
+    emit_event("manifest_failure", artifact_kind=kind, file=path,
+               reason=problem)
+    raise IntegrityError(f"integrity check failed for {path}: {problem}")
+
+
+def maybe_verify_manifest(root: str, kind: str = "artifact") -> bool:
+    """``verify_manifest`` when ``root`` has one; False (no-op) when it
+    doesn't — the back-compat path for pre-manifest artifacts."""
+    if not os.path.exists(os.path.join(root, MANIFEST_FILENAME)):
+        logger.debug("no manifest under %s; skipping verification", root)
+        return False
+    verify_manifest(root, kind=kind)
+    return True
+
+
+# -- single-entry helpers (results-checkpoint fall-back discipline) -----------
+
+
+def update_manifest_entry(directory: str, filename: str) -> None:
+    """Insert/refresh one file's entry in ``directory/manifest.json``
+    (read-modify-write, atomic replace). An unreadable existing manifest is
+    replaced rather than trusted — the writer is the source of truth."""
+    path = os.path.join(directory, MANIFEST_FILENAME)
+    manifest = _load_manifest(path) or {
+        "version": MANIFEST_VERSION, "files": {},
+    }
+    manifest["files"][filename] = _file_entry(directory, filename)
+    _write_json_atomic(manifest, path)
+
+
+def verify_manifest_entry(
+    directory: str, filename: str, kind: str = "results"
+) -> bool:
+    """True when ``filename`` matches its manifest entry — or has none (no
+    manifest, or an unlisted file: both verify trivially, pre-manifest
+    checkpoints must keep resuming). False on a mismatch, counted and
+    logged; callers fall back to an older artifact instead of raising."""
+    manifest = _load_manifest(os.path.join(directory, MANIFEST_FILENAME))
+    if manifest is None:
+        return True
+    entry = manifest["files"].get(filename)
+    if entry is None:
+        return True
+    reg = get_registry()
+    reg.counter("manifest_verifications_total", kind=kind).inc()
+    problem = _check_entry(directory, filename, entry)
+    if problem is None:
+        return True
+    reg.counter("manifest_failures_total", kind=kind).inc()
+    emit_event("manifest_failure", artifact_kind=kind,
+               file=os.path.join(directory, filename), reason=problem)
+    logger.warning("manifest mismatch (%s): %s", kind, problem)
+    return False

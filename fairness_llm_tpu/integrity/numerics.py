@@ -1,0 +1,69 @@
+"""On-device numerics guards: one reduced finite flag per compiled chunk.
+
+A NaN or Inf in the logits is the silent killer of a greedy sweep:
+``argmax`` over a NaN row returns index 0 on every backend we target, so a
+numerically-poisoned decode emits a plausible-looking stream of token 0s (or
+worse, of *almost*-right tokens when only a few rows are hit) and the
+fairness report downstream is garbage with no error anywhere.
+
+The guard is deliberately shaped for the decode hot path:
+
+- **Device-side AND-reduction.** Each compiled program folds
+  ``masked_finite(logits, live)`` into a single boolean carried through its
+  ``while_loop`` — per chunk, not per token. The flag travels back with the
+  outputs the host already fetches, so a guarded step issues the same
+  number of host syncs as an unguarded one.
+- **Live-row masking.** Bucket-padding rows, finished rows, and released
+  slots carry whatever bytes they carry (a released slot's carried logits
+  may legitimately be stale garbage); only rows that are actually decoding
+  can trip the flag.
+- **Host-side classification.** ``check_finite`` turns a tripped flag into
+  a :class:`~fairness_llm_tpu.utils.failures.NumericsFault` — a
+  ``DecodeFault`` subclass, so the serving scheduler's slot-requeue, the
+  pipeline's chunk retry, and the circuit breakers all absorb it as they
+  would any other decode fault — plus a per-stage
+  ``numerics_faults_total{component,stage}`` counter and a JSONL event.
+
+Guarded and unguarded programs compile under distinct keys (the flag
+changes the return arity), and the guard never touches the sampled/argmax
+token stream — greedy output with guards on is token-for-token identical to
+guards off (pinned in tests/test_integrity.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.utils.failures import NumericsFault
+
+
+def masked_finite(values: jnp.ndarray, live: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Scalar bool: every element of ``values`` is finite, counting only
+    rows where ``live`` (a [B] mask over the leading axis) is True. Traced
+    inside compiled programs — keep it a pure reduction."""
+    ok = jnp.isfinite(values)
+    if live is not None:
+        ok = ok | (~live).reshape((-1,) + (1,) * (values.ndim - 1))
+    return jnp.all(ok)
+
+
+def check_finite(flag, component: str, stage: str) -> None:
+    """Host-side classification of a chunk's finite flag.
+
+    ``flag`` may be a device scalar (forcing it here is free: callers check
+    after fetching the chunk's tokens, so the program already completed).
+    Raises :class:`NumericsFault` on a tripped flag; the message names the
+    component/stage so containment logs are actionable."""
+    if bool(flag):
+        return
+    get_registry().counter(
+        "numerics_faults_total", component=component, stage=stage
+    ).inc()
+    emit_event("numerics_fault", component=component, stage=stage)
+    raise NumericsFault(
+        f"non-finite logits in {component} {stage} chunk (numerics guard); "
+        "discarding the chunk's tokens"
+    )

@@ -331,6 +331,9 @@ def backend_for(
     mesh = None
     if config.mesh.num_devices > 1:
         mesh = make_mesh(config.mesh)
+    from fairness_llm_tpu.config import IntegrityConfig
+
+    integrity = getattr(config, "integrity", None) or IntegrityConfig()
     ckpt = os.path.join(config.weights_dir or "", model_name)
     tokenizer_path = None
     loaded_params = params
@@ -339,7 +342,12 @@ def backend_for(
         from fairness_llm_tpu.runtime.weights import load_checkpoint
 
         logger.info("loading %s weights from %s", model_name, ckpt)
-        loaded_params = load_checkpoint(model_config, ckpt, mesh=mesh)
+        # Manifest-verified load (integrity/): a bit-flipped or truncated
+        # shard is refused HERE, naming the file — never served.
+        loaded_params = load_checkpoint(
+            model_config, ckpt, mesh=mesh,
+            verify=integrity.verify_manifests,
+        )
         loaded_sharded = mesh is not None
         if os.path.exists(os.path.join(ckpt, "tokenizer_config.json")):
             tokenizer_path = ckpt
@@ -356,6 +364,7 @@ def backend_for(
         tokenizer_path=tokenizer_path,
         seed=config.random_seed,
         assume_sharded=loaded_sharded,
+        numerics_guards=integrity.numerics_guards,
     )
     resilience = getattr(config, "resilience", None)
     if resilience is not None and not resilience.enabled:
@@ -376,7 +385,8 @@ def backend_for(
                 rotate_every=resilience.journal_rotate_every,
             )
         return ServingBackend(engine, serving, name=model_name,
-                              resilience=resilience, journal=journal)
+                              resilience=resilience, journal=journal,
+                              integrity=integrity)
     # Speculation rides on the backend (not the engine default) so sweeps
     # opted in via Config get it while direct engine users stay explicit.
     spec = getattr(config, "speculation", None)

@@ -21,13 +21,21 @@ _NUMBERED = re.compile(r"^\s*(\d+)[\.\)\:]\s*(.+?)\s*$")
 _YEAR_SUFFIX = re.compile(r"\s*\((19|20)\d{2}\)\s*$")
 
 
+def _clean_item(text: str) -> str:
+    """Shared per-item cleanup for every list parser: whitespace, wrapping
+    quotes, and markdown ``*`` emphasis (models bold titles as ``**Title**``
+    in comma lists just as readily as in numbered ones — the two parsers
+    must not disagree on what a title is)."""
+    return text.strip().strip('"').strip("*").strip()
+
+
 def parse_numbered_list(text: str, max_items: int = 10) -> List[str]:
     """'1. Title' lines -> titles (reference numbered-list contract)."""
     out: List[str] = []
     for line in text.splitlines():
         m = _NUMBERED.match(line)
         if m:
-            title = m.group(2).strip().strip('"').strip("*").strip()
+            title = _clean_item(m.group(2))
             if title:
                 out.append(title)
         if len(out) >= max_items:
@@ -41,7 +49,7 @@ def parse_comma_list(text: str, max_items: int = 10) -> List[str]:
         line = line.strip()
         if not line:
             continue
-        items = [t.strip().strip('"') for t in line.split(",")]
+        items = [_clean_item(t) for t in line.split(",")]
         return [t for t in items if t][:max_items]
     return []
 

@@ -12,26 +12,79 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 
-def save_results(results: Dict[str, Any], path: str) -> None:
+def _sanitize_non_finite(obj: Any, path: str = "") -> Tuple[Any, List[str]]:
+    """Copy ``obj`` with NaN/Inf floats replaced by None, returning the
+    dotted paths of every replacement. Fairness metrics CAN legitimately be
+    NaN (an empty demographic group divides by zero), and ``json.dump``'s
+    default ``allow_nan=True`` would emit bare ``NaN`` tokens — not JSON,
+    rejected by every strict parser downstream (jq, browsers, pandas with
+    default settings). Fresh containers throughout: the caller's in-memory
+    dict is never mutated. ``np.float64`` subclasses ``float``, so numpy
+    scalars are covered; non-float types json can't encode still fall to
+    ``default=str`` as before."""
+    if isinstance(obj, dict):
+        bad: List[str] = []
+        out: Dict = {}
+        for k, v in obj.items():
+            sv, sb = _sanitize_non_finite(v, f"{path}.{k}" if path else str(k))
+            out[k] = sv
+            bad.extend(sb)
+        return out, bad
+    if isinstance(obj, (list, tuple)):
+        bad = []
+        items = []
+        for i, v in enumerate(obj):
+            sv, sb = _sanitize_non_finite(v, f"{path}[{i}]")
+            items.append(sv)
+            bad.extend(sb)
+        return items, bad
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None, [path or "<root>"]
+    return obj, []
+
+
+def save_results(results: Dict[str, Any], path: str, manifest: bool = True) -> None:
     """Atomic-rename write: a PROCESS interrupt mid-write leaves the previous
     file intact (resume depends on it). fsync before rename extends that to
     most system-crash orderings too, though no rename dance is a durability
     guarantee across power loss — the resume loader's corrupt-file fallback
-    is the final backstop."""
+    is the final backstop.
+
+    Non-finite floats are sanitized to ``null`` (strict-JSON output; the
+    sanitized key paths are recorded in the result's ``metadata``), and the
+    written file's sha256 lands in the directory's ``manifest.json``
+    (``integrity/manifest.py``) so resume can refuse a corrupted artifact.
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    results, sanitized = _sanitize_non_finite(results)
+    if sanitized:
+        md = results.get("metadata")
+        if isinstance(md, dict):
+            md["sanitized_non_finite"] = sanitized
+        else:
+            results["sanitized_non_finite"] = sanitized
+        logger.warning(
+            "results %s: %d non-finite value(s) sanitized to null (%s%s)",
+            path, len(sanitized), ", ".join(sanitized[:5]),
+            "…" if len(sanitized) > 5 else "",
+        )
     # Per-pid tmp name: concurrent writers (multi-host ranks, pytest -n) must
     # not truncate each other's in-flight tmp before its atomic rename.
     tmp = f"{path}.{os.getpid()}.tmp"
     try:
         with open(tmp, "w") as f:
-            json.dump(results, f, indent=2, default=str)
+            # allow_nan=False as a regression guard: any non-finite float
+            # that slips past sanitization fails HERE, loudly, instead of
+            # writing a file strict parsers reject.
+            json.dump(results, f, indent=2, default=str, allow_nan=False)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -41,6 +94,20 @@ def save_results(results: Dict[str, Any], path: str) -> None:
         except OSError:
             pass
         raise
+    if manifest:
+        from fairness_llm_tpu.integrity.manifest import update_manifest_entry
+
+        # The rename above and this manifest update are two separate atomic
+        # writes, so a kill between them (or a cross-process read-modify-
+        # write race on manifest.json) can leave a STALE digest for a valid
+        # file. That window is accepted deliberately: a stale entry makes
+        # the loader skip to the next-older valid checkpoint — bounded
+        # recompute — whereas trusting a mismatched digest would reopen the
+        # silent-corruption hole this manifest exists to close. (A dropped
+        # entry from the RMW race is harmless: unlisted files verify
+        # trivially.)
+        update_manifest_entry(os.path.dirname(path) or ".",
+                              os.path.basename(path))
     logger.info("saved results to %s", path)
 
 
@@ -78,7 +145,18 @@ def load_latest_checkpoint(results_dir: str, phase: str) -> Dict[str, Any]:
     # Newest first; fall back through older checkpoints if one is unreadable
     # (writes are atomic now, but checkpoints from older versions — or a
     # filesystem mishap — shouldn't make resume WORSE than starting over).
+    from fairness_llm_tpu.integrity.manifest import verify_manifest_entry
+
     for _, fname in sorted(numbered, reverse=True):
+        if not verify_manifest_entry(d, fname, kind="results"):
+            # Parses fine, WRONG BYTES: a digest mismatch means corruption
+            # the JSON layer can't see (a flipped digit in a metric is
+            # still valid JSON). Same ladder as an unreadable file — the
+            # next-older valid checkpoint wins over resuming garbage.
+            logger.warning(
+                "skipping checkpoint %s: manifest digest mismatch", fname
+            )
+            continue
         try:
             data = load_results(os.path.join(d, fname)) or {}
         except (ValueError, OSError) as e:

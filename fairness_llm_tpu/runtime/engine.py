@@ -121,14 +121,21 @@ class DecodeEngine:
         assume_sharded: bool = False,
         param_dtype: Optional[str] = None,
         speculation: Optional[SpeculationConfig] = None,
+        numerics_guards: bool = False,
     ):
         """``assume_sharded=True`` skips re-placing params onto the mesh —
         for callers (weights loader) that already device_put each tensor onto
         its NamedSharding at load time. ``param_dtype`` ("float32"/"bfloat16")
         overrides the size-based storage policy. ``speculation`` sets the
-        engine-wide default for ``generate`` (per-call arg overrides)."""
+        engine-wide default for ``generate`` (per-call arg overrides).
+        ``numerics_guards`` folds an on-device finite check of the logits
+        into every compiled decode program (integrity/numerics.py): one
+        AND-reduced flag per chunk, raised host-side as a containable
+        ``NumericsFault``. Guarded/unguarded programs compile under
+        disjoint keys; the token stream is identical either way."""
         self.config = model_config
         self.speculation = speculation
+        self.numerics_guards = bool(numerics_guards)
         # Resilience hooks (resilience/): ``breakers`` — a BreakerBoard whose
         # "speculate" stage gates the speculative path (a persistently-
         # failing spec program trips it open and generate falls back to the
@@ -268,14 +275,17 @@ class DecodeEngine:
         return fn
 
     def _decode_fn(self, batch: int, prompt_len: int, max_new: int,
-                   sampler_settings: SamplerSettings, prefix_len: int = 0):
+                   sampler_settings: SamplerSettings, prefix_len: int = 0,
+                   guard: bool = False):
         # The leading "decode" tag IS the speculation slot of the compile
         # key: speculative programs live under disjoint ("spec_decode", ...,
         # ngram_max, draft_len) keys (and their shapes/returns differ), so
         # toggling speculation can NEVER reuse a stale compiled step for the
-        # other mode (pinned by test_spec_compile_keys_disjoint).
+        # other mode (pinned by test_spec_compile_keys_disjoint). ``guard``
+        # (the numerics-guard flag) changes the return arity, so it is part
+        # of the key for the same stale-program reason.
         key = ("decode", batch, prompt_len, max_new, sampler_settings,
-               prefix_len)
+               prefix_len, guard)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -285,6 +295,8 @@ class DecodeEngine:
         sample = make_sampler(sampler_settings)
         pad_id = self.tokenizer.pad_id
         eos_id = self.tokenizer.eos_id
+        if guard:
+            from fairness_llm_tpu.integrity.numerics import masked_finite
 
         def run(params, tokens, valid, row_seeds, row_live, shared_layers):
             # positions: global (prefix offset + 0..len-1); pad slots clamped
@@ -308,11 +320,11 @@ class DecodeEngine:
             toks0 = jnp.full((batch, max_new), pad_id, jnp.int32)
 
             def cond(carry):
-                step_idx, _, _, done, _ = carry
+                step_idx, _, _, done = carry[0], carry[1], carry[2], carry[3]
                 return (step_idx < max_new) & ~jnp.all(done)
 
             def body(carry):
-                step_idx, cache, prev_logits, done, toks = carry
+                step_idx, cache, prev_logits, done, toks = carry[:5]
                 step_keys = jax.vmap(jax.random.fold_in, (0, None))(row_keys, step_idx)
                 tok = sample(prev_logits, step_keys)
                 tok = jnp.where(done, pad_id, tok)
@@ -330,12 +342,24 @@ class DecodeEngine:
                     cache,
                     shared_layers=shared_layers,
                 )
-                return (step_idx + 1, cache, logits[:, -1, :], done_next, toks)
+                out = (step_idx + 1, cache, logits[:, -1, :], done_next, toks)
+                if guard:
+                    # Rows live this step contributed real logits; fold their
+                    # finiteness into the chunk flag (one reduced bool, read
+                    # with the tokens — never a per-token host sync).
+                    out += (carry[5] & masked_finite(logits[:, -1, :], step_valid),)
+                return out
 
             # Bucket-padding rows start done: the early exit must wait only on
             # REAL prompts, not on garbage rows happening to sample EOS.
             done0 = ~row_live
             init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, toks0)
+            if guard:
+                # Prefill's last logits are the first sample's distribution —
+                # the check covers them too (live rows only).
+                init += (masked_finite(last_logits, row_live),)
+                carry_out = jax.lax.while_loop(cond, body, init)
+                return carry_out[4], carry_out[5]  # toks [B, max_new], finite
             _, _, _, _, toks = jax.lax.while_loop(cond, body, init)
             return toks  # [B, max_new]
 
@@ -345,7 +369,8 @@ class DecodeEngine:
         return fn
 
     def _spec_decode_fn(self, batch: int, prompt_len: int, max_new: int,
-                        prefix_len: int, spec: SpeculationConfig):
+                        prefix_len: int, spec: SpeculationConfig,
+                        guard: bool = False):
         """Compiled speculative decode: greedy draft-and-verify.
 
         One while_loop iteration = ONE multi-token verify forward over
@@ -363,8 +388,11 @@ class DecodeEngine:
         slots so the last verify window of a nearly-finished row still fits.
         """
         k = spec.draft_len
+        # ``guard`` sits mid-key (not last): the speculation knobs stay the
+        # key's trailing pair, which diagnostics (and the compile-key test)
+        # rely on.
         key = ("spec_decode", batch, prompt_len, max_new, prefix_len,
-               spec.ngram_max, k)
+               guard, spec.ngram_max, k)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -373,6 +401,8 @@ class DecodeEngine:
         model = self.model
         pad_id = self.tokenizer.pad_id
         eos_id = self.tokenizer.eos_id
+        if guard:
+            from fairness_llm_tpu.integrity.numerics import masked_finite
         S = k + 1
         cache_len = prompt_len + max_new + k
         gen_len = max_new + k  # emit buffer widened so a verify window never
@@ -409,11 +439,12 @@ class DecodeEngine:
             counters0 = jnp.zeros((3,), jnp.int32)  # drafted, accepted, steps
 
             def cond(carry):
-                step_idx, _, _, done, _, _, _ = carry
+                step_idx, done = carry[0], carry[3]
                 return (step_idx < max_new) & ~jnp.all(done)
 
             def body(carry):
-                step_idx, cache, prev_logits, done, gen, out_len, counters = carry
+                step_idx, cache, prev_logits, done, gen, out_len, counters = \
+                    carry[:7]
                 live = ~done
                 # The step's guaranteed token: greedy argmax of the carried
                 # logits (identical to the plain loop's sample at temp 0).
@@ -494,11 +525,22 @@ class DecodeEngine:
                     jnp.sum(jnp.maximum(e - 1, 0), dtype=jnp.int32),
                     jnp.ones((), jnp.int32),
                 ])
-                return (step_idx + 1, nc, prev_logits, done, gen, out_len,
-                        counters)
+                out = (step_idx + 1, nc, prev_logits, done, gen, out_len,
+                       counters)
+                if guard:
+                    # The whole [B, S, V] verify window must be finite: the
+                    # accepted tokens AND the carried next-step logits both
+                    # come out of it.
+                    out += (carry[7] & masked_finite(logits, live),)
+                return out
 
             init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, gen0,
                     out_len0, counters0)
+            if guard:
+                init += (masked_finite(last_logits, row_live),)
+                carry_out = jax.lax.while_loop(cond, body, init)
+                return (carry_out[4][:, :max_new], carry_out[5], carry_out[6],
+                        carry_out[7])
             _, _, _, _, gen, out_len, counters = jax.lax.while_loop(
                 cond, body, init
             )
@@ -680,12 +722,15 @@ class DecodeEngine:
 
         prefix_len = len(shared_ids) if shared_ids is not None else 0
 
+        guard = self.numerics_guards
+
         def build_fn():
             if use_spec:
                 return self._spec_decode_fn(
-                    batch, prompt_len, max_new, prefix_len, spec
+                    batch, prompt_len, max_new, prefix_len, spec, guard=guard
                 )
-            return self._decode_fn(batch, prompt_len, max_new, sampler, prefix_len)
+            return self._decode_fn(batch, prompt_len, max_new, sampler,
+                                   prefix_len, guard=guard)
 
         # Snapshot for the watchdog's compile exemption below: if this call
         # grows the compiled-program cache (first use of a shape, a VMEM/
@@ -808,12 +853,13 @@ class DecodeEngine:
                 res = call(fn)
             else:
                 raise
-        else:
-            if use_spec and self.breakers is not None:
-                self.breakers.record_success("speculate")
         spec_stats = None
+        finite_dev = None
         if use_spec:
-            toks_dev, out_len_dev, counters_dev = res
+            if guard:
+                toks_dev, out_len_dev, counters_dev, finite_dev = res
+            else:
+                toks_dev, out_len_dev, counters_dev = res
             out = np.asarray(jax.device_get(toks_dev))[:n]
             counters = np.asarray(jax.device_get(counters_dev))
             emitted = int(np.asarray(jax.device_get(out_len_dev))[:n].sum())
@@ -823,7 +869,35 @@ class DecodeEngine:
                 draft_len=spec.draft_len, ngram_max=spec.ngram_max,
             )
         else:
+            if guard:
+                res, finite_dev = res
             out = np.asarray(jax.device_get(res))[:n]
+        if finite_dev is not None:
+            # Numerics guard (integrity/numerics.py): a tripped chunk flag
+            # discards the chunk's tokens as a containable NumericsFault —
+            # with_failure_containment retries once then sentinels, same as
+            # any other decode fault. Checked before hang classification
+            # (the more specific diagnosis wins).
+            from fairness_llm_tpu.integrity.numerics import check_finite
+
+            try:
+                check_finite(
+                    jax.device_get(finite_dev), "engine",
+                    "speculate" if use_spec else "decode",
+                )
+            except Exception:
+                if use_spec and self.breakers is not None:
+                    # A numerically-sick speculative path must feed its
+                    # breaker like a crashed one: enough consecutive trips
+                    # shed the path until a half-open probe.
+                    self.breakers.record_failure("speculate")
+                raise
+        # Speculate-breaker success only once the chunk is KNOWN good —
+        # recording it before the finite check would let a persistently
+        # NaN-poisoned verify window reset the count every call and the
+        # breaker would never open.
+        if use_spec and self.breakers is not None:
+            self.breakers.record_success("speculate")
         if self.watchdog is not None:
             # Hang classification once the host has the tokens (post-hoc by
             # construction — a single-threaded loop can't interrupt its own

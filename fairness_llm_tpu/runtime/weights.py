@@ -188,14 +188,26 @@ def load_checkpoint(
     mesh: Optional[jax.sharding.Mesh] = None,
     family: Optional[str] = None,
     dtype: Optional[Any] = None,
+    verify: bool = True,
 ) -> Any:
     """Load an HF safetensors checkpoint dir into our param tree.
 
     With ``mesh``, each tensor is placed onto its tensor-parallel NamedSharding
     as it streams off disk; without, tensors land on the default device.
+
+    ``verify`` (default on) checks the directory's sha256 ``manifest.json``
+    (``integrity/manifest.py``) before any tensor is read: a bit-flipped or
+    truncated shard raises ``IntegrityError`` naming the file, instead of
+    loading garbage weights that decode plausible-looking garbage text.
+    Directories without a manifest load unverified (pre-manifest
+    checkpoints), with a debug note.
     """
     from safetensors import safe_open
 
+    if verify:
+        from fairness_llm_tpu.integrity.manifest import maybe_verify_manifest
+
+        maybe_verify_manifest(path, kind="weights")
     dtype = dtype or (jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32)
     name_map = hf_name_map(cfg, family)
     weight_map = _checkpoint_index(path)
@@ -360,6 +372,13 @@ def save_checkpoint_hf(cfg: ModelConfig, params: Any, path: str, family: Optiona
         out[hf_name] = jnp.concatenate(parts, axis=-1)
     os.makedirs(path, exist_ok=True)
     save_file(out, os.path.join(path, "model.safetensors"))
+    # Verified-artifact manifest (integrity/manifest.py): per-file sha256 +
+    # tensor shape/dtype summary, checked by load_checkpoint. Covers every
+    # file present at save time; files added later (tokenizer, provenance)
+    # simply go unlisted and unverified.
+    from fairness_llm_tpu.integrity.manifest import write_manifest
+
+    write_manifest(path)
 
 
 def _tree_get(tree: Any, path: str) -> Any:

@@ -23,6 +23,10 @@ Differences from ``EngineBackend`` that callers should know:
   last rung lives here: at level 3 (``static_fallback``) new ``generate``
   calls route through the static ``DecodeEngine`` path — the numerically-
   reference program — until the ladder retreats.
+- with ``integrity.canary_every_n`` set, a golden-prompt canary
+  (``integrity/canary.py``) decodes through the live scheduler every N
+  generate calls, compared token-for-token against a static-engine
+  reference; a mismatch trips the decode breaker and the ladder above.
 """
 
 from __future__ import annotations
@@ -32,7 +36,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from fairness_llm_tpu.config import ModelSettings, ResilienceConfig, ServingConfig
+from fairness_llm_tpu.config import (
+    IntegrityConfig,
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+)
 from fairness_llm_tpu.resilience.breaker import BreakerBoard
 from fairness_llm_tpu.resilience.drain import ServingJournal
 from fairness_llm_tpu.serving.request import Request
@@ -50,13 +59,21 @@ class ServingBackend:
     def __init__(self, engine, serving: Optional[ServingConfig] = None,
                  name: Optional[str] = None, fault_injector=None,
                  resilience: Optional[ResilienceConfig] = None,
-                 journal: Optional[ServingJournal] = None):
+                 journal: Optional[ServingJournal] = None,
+                 integrity: Optional[IntegrityConfig] = None):
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.name = name or engine.config.name
         self.fault_injector = fault_injector
         self.resilience = resilience
         self.journal = journal
+        self.integrity = integrity
+        # Canary probe (integrity/canary.py): built lazily on the first
+        # generate() — recording its reference costs one static-engine
+        # decode, which must not land in backend construction (weight
+        # loading time for big models).
+        self._canary = None
+        self._canary_sched = None
         self.board: Optional[BreakerBoard] = None
         if resilience is not None and resilience.enabled:
             # ONE board for the whole backend: every scheduler's prefill/
@@ -101,6 +118,55 @@ class ServingBackend:
             del self._schedulers[keys.pop(0)]
         self._schedulers[key] = sched
         return sched
+
+    def _maybe_canary(self) -> None:
+        """Arm (lazily) and run the canary probe when due: every
+        ``integrity.canary_every_n`` generate calls, the golden prompt
+        decodes through the live scheduler and is compared token-for-token
+        against the static-engine reference recorded on first use. A
+        mismatch trips the decode breaker — the degradation ladder handles
+        the rest (see integrity/canary.py). Runs BEFORE the user batch, so
+        detected corruption degrades the path before more traffic lands on
+        it."""
+        integ = self.integrity
+        if integ is None or integ.canary_every_n <= 0:
+            return
+        if self._canary is None:
+            from fairness_llm_tpu.integrity.canary import CanaryProbe
+
+            self._canary = CanaryProbe.record(
+                self.engine,
+                max_tokens=integ.canary_max_tokens,
+                every_n=integ.canary_every_n,
+                board=self.board,
+            )
+        if self._canary.tick():
+            self._canary.probe(self._canary_scheduler())
+
+    def _canary_scheduler(self) -> ContinuousScheduler:
+        """The scheduler the canary decodes through. When user traffic is
+        itself greedy, that's the LIVE user scheduler (the probe then
+        exercises the exact compiled programs + KV pool serving requests);
+        otherwise a dedicated greedy scheduler held OUTSIDE the LRU —
+        routing it through ``scheduler_for`` would evict a warm user
+        scheduler (KV pool + compiled step) every ``canary_every_n`` calls.
+        The dedicated scheduler shares the board (its outcomes must feed
+        the same breakers) but not the journal: probes are synthetic
+        traffic a successor process must never resume. Sampled-settings
+        schedulers are NOT probed token-for-token — only greedy decode has
+        a deterministic reference — so for sampled workloads the canary
+        covers the shared engine/model/weights path, not that scheduler's
+        own sampler program."""
+        s = self._canary.settings
+        live = self._schedulers.get((s.temperature, s.top_k, s.top_p))
+        if live is not None:
+            return live
+        if self._canary_sched is None:
+            self._canary_sched = ContinuousScheduler(
+                self.engine, self.serving, settings=s,
+                resilience=self.resilience, breakers=self.board,
+            )
+        return self._canary_sched
 
     def generate(
         self,
@@ -158,6 +224,7 @@ class ServingBackend:
             self.last_output = out
             return list(out.texts)
         sched = self.scheduler_for(settings)
+        self._maybe_canary()
         requests = []
         for i, p in enumerate(prompts):
             if keys is not None:

@@ -49,6 +49,14 @@ static-engine fallback), and a drain request (SIGTERM/SIGINT via
 slots ``drain_grace_s`` to finish, and preempts the rest — journaled
 requests resume in a successor process via ``resume-serving``.
 
+Integrity (``integrity/``): when the engine's ``numerics_guards`` flag is
+set, every compiled prefill/decode program AND-reduces a finite check of
+its logits into one flag per chunk; a tripped flag discards the chunk as a
+containable ``NumericsFault`` (requeue-once, breaker-visible, counted in
+``numerics_faults_total``) instead of delivering silently-garbage tokens.
+``ScriptedFaultInjector(corruptions=...)`` poisons a request's carried
+logits host-side so the guard is drillable on the CPU harness.
+
 Sharded meshes are not supported yet (the slot scatter would need dp-aware
 placement); serving targets the single-chip engine — multi-replica routing
 is the next layer up, not this one.
@@ -85,7 +93,12 @@ from fairness_llm_tpu.telemetry import (
     emit_event,
     get_registry,
 )
-from fairness_llm_tpu.utils.failures import DecodeFault, HangFault
+from fairness_llm_tpu.integrity.numerics import check_finite, masked_finite
+from fairness_llm_tpu.utils.failures import (
+    DecodeFault,
+    HangFault,
+    NumericsFault,
+)
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
 
@@ -232,7 +245,13 @@ class ContinuousScheduler:
         # branch does.
         return (1, 2)
 
-    def _prefill_fn(self, nb: int, P: int):
+    def _guard(self) -> bool:
+        """Numerics-guard flag, read from the engine (one switch for the
+        static and serving paths). Part of every compiled-program key —
+        guarded programs return an extra finite flag."""
+        return bool(getattr(self.engine, "numerics_guards", False))
+
+    def _prefill_fn(self, nb: int, P: int, guard: bool):
         """[nb, P] prompt prefill + row scatter into the shared cache.
 
         Numerically the engine's prefill: left-padded tokens, positions from
@@ -242,12 +261,13 @@ class ContinuousScheduler:
         rows) drop. Rows' tail slots [P, cache_len) are re-invalidated here,
         so a recycled slot never exposes its previous tenant's keys.
         """
-        key = ("serve_prefill", nb, P)
+        key = ("serve_prefill", nb, P, guard)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
         cfg = self.engine.config
         model = self.engine.model
+        num_slots = self.num_slots
 
         def run(params, cache, prev_logits, tokens, valid, slots):
             positions = jnp.maximum(
@@ -284,6 +304,12 @@ class ContinuousScheduler:
             new_logits = prev_logits.at[slots].set(
                 logits[:, -1, :], mode="drop"
             )
+            if guard:
+                # Real admissions only (batch-bucket pad rows scatter-drop
+                # and may hold anything): one reduced flag for the batch.
+                return new_cache, new_logits, masked_finite(
+                    logits[:, -1, :], slots < num_slots
+                )
             return new_cache, new_logits
 
         # No donation here even on TPU: a prefill failure must leave the
@@ -306,7 +332,9 @@ class ContinuousScheduler:
         # The chunk length is baked into the compiled while_loop, and the
         # degradation ladder can change it mid-run — key on it so a halved
         # chunk compiles its own program and restoring reuses the original.
-        key = ("serve_step", self.decode_chunk)
+        # The numerics-guard flag changes the return arity, so it keys too.
+        guard = self._guard()
+        key = ("serve_step", self.decode_chunk, guard)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -334,11 +362,12 @@ class ContinuousScheduler:
             counters0 = jnp.zeros((2,), jnp.int32)  # steps, live-row-steps
 
             def cond(carry):
-                t, _, _, done, _, _, _ = carry
+                t, done = carry[0], carry[3]
                 return (t < T) & ~jnp.all(done)
 
             def body(carry):
-                t, cache, prev_logits, done, emitted, toks, counters = carry
+                t, cache, prev_logits, done, emitted, toks, counters = \
+                    carry[:7]
                 live = ~done
                 step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
                 tok = sample(prev_logits, step_keys)
@@ -360,10 +389,22 @@ class ContinuousScheduler:
                 counters = counters + jnp.stack(
                     [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
                 )
-                return (t + 1, cache, prev_logits, done, emitted, toks, counters)
+                out = (t + 1, cache, prev_logits, done, emitted, toks,
+                       counters)
+                if guard:
+                    out += (carry[7] & masked_finite(logits[:, -1, :], live),)
+                return out
 
             init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
                     emitted0, toks0, counters0)
+            if guard:
+                # Entry check covers the CARRIED logits (the sample source —
+                # where host-side NaN injection, and a poisoned prefill that
+                # slipped a disabled guard, would sit). Live rows only:
+                # released slots legitimately carry stale garbage.
+                init += (masked_finite(prev_logits, live0),)
+                c = jax.lax.while_loop(cond, body, init)
+                return c[1], c[2], c[5], c[4], c[6], c[7]
             _, cache, prev_logits, _, emitted, toks, counters = \
                 jax.lax.while_loop(cond, body, init)
             return cache, prev_logits, toks, emitted, counters
@@ -796,19 +837,29 @@ class ContinuousScheduler:
         slot_ids[: len(admitted)] = slots
         # First use of this (batch, prompt) bucket compiles; that wall is
         # exempt from hang classification (injected stalls still classify).
-        first_compile = ("serve_prefill", nb, P) not in self._compiled
-        fn = self._prefill_fn(nb, P)
+        guard = self._guard()
+        first_compile = ("serve_prefill", nb, P, guard) not in self._compiled
+        fn = self._prefill_fn(nb, P, guard)
         pf_t0 = time.monotonic()
         for req in reqs:
             self.tracer.record(req.id, "prefill_start", t=pf_t0)
         if self.watchdog is not None:
             self.watchdog.arm("prefill")
         try:
-            self._cache, self._prev_logits = fn(
+            out = fn(
                 self.engine.params, self._cache, self._prev_logits,
                 jnp.asarray(tokens), jnp.asarray(valid),
                 jnp.asarray(slot_ids),
             )
+            if guard:
+                new_cache, new_logits, finite = out
+                # Checked BEFORE the state swap: prefill isn't donated, so a
+                # poisoned batch leaves the previous cache/logits untouched
+                # (the containment branch releases the new slots).
+                check_finite(finite, "serving", "prefill")
+            else:
+                new_cache, new_logits = out
+            self._cache, self._prev_logits = new_cache, new_logits
             if self.watchdog is not None:
                 # Post-hoc hang classification (see resilience/watchdog.py):
                 # an over-budget prefill raises HangFault INTO the
@@ -817,18 +868,20 @@ class ContinuousScheduler:
                 self.watchdog.observe("prefill", extra_s=injected_hang,
                                       classify=not first_compile)
         except Exception as e:  # noqa: BLE001 — containment is the point
-            hang = isinstance(e, HangFault)
+            kind = ("hang" if isinstance(e, HangFault)
+                    else "numerics" if isinstance(e, NumericsFault)
+                    else "device")
             logger.warning("prefill batch (%d, %d) failed: %s", nb, P, e)
             get_registry().counter(
                 "faults_total", component="serving",
-                kind="hang" if hang else "device", stage="prefill",
+                kind=kind, stage="prefill",
             ).inc()
             if self.breakers is not None:
                 self.breakers.record_failure("prefill")
             for slot, req in zip(slots, reqs):
                 self.pool.release(slot)
                 self._requeue_or_fail(req, f"prefill failed: {e}", stats,
-                                      cause="hang" if hang else "device")
+                                      cause=kind)
             return True
         if self.breakers is not None:
             self.breakers.record_success("prefill")
@@ -862,6 +915,18 @@ class ContinuousScheduler:
                     injected_hang += hang_fn(
                         self.pool.get(slot).request.id, "decode"
                     )
+            corrupt_fn = getattr(self.fault_injector, "maybe_corrupt", None)
+            if corrupt_fn is not None:
+                for slot in self.pool.live_slots():
+                    mode = corrupt_fn(self.pool.get(slot).request.id, "decode")
+                    if mode is not None:
+                        # Scripted silent corruption: poison the slot's
+                        # CARRIED logits (the sample source) host-side. With
+                        # the numerics guard armed the chunk faults as
+                        # NumericsFault; without it, this is exactly the
+                        # garbage-argmax failure the guard exists to catch.
+                        bad = float("nan") if mode == "nan" else float("inf")
+                        self._prev_logits = self._prev_logits.at[slot].set(bad)
         live_ids = self.pool.live_slots()
         if not live_ids:
             return False
@@ -886,19 +951,35 @@ class ContinuousScheduler:
             caps[slot] = self._cap_for(st.request)
             seed = st.request.row_seed
             seeds[slot] = np.uint32((0 if seed is None else seed) & 0xFFFFFFFF)
-        first_compile = ("serve_step", self.decode_chunk) not in self._compiled
+        guard = self._guard()
+        first_compile = ("serve_step", self.decode_chunk, guard) \
+            not in self._compiled
         fn = self._step_fn()
         if self.watchdog is not None:
             self.watchdog.arm("decode")
         try:
-            self._cache, self._prev_logits, toks, emitted_after, counters = fn(
+            out = fn(
                 self.engine.params, self._cache, self._prev_logits,
                 jnp.asarray(seeds), jnp.asarray(emitted), jnp.asarray(base),
                 jnp.asarray(caps), jnp.asarray(live), jnp.asarray(reset),
             )
+            if guard:
+                (self._cache, self._prev_logits, toks, emitted_after,
+                 counters, finite) = out
+            else:
+                self._cache, self._prev_logits, toks, emitted_after, \
+                    counters = out
             toks = np.asarray(jax.device_get(toks))
             emitted_after = np.asarray(jax.device_get(emitted_after))
             counters = np.asarray(jax.device_get(counters))
+            if guard:
+                # A tripped finite flag discards the whole chunk as a
+                # NumericsFault into the containment branch below — the
+                # donated cache was already consumed, so the rebuild there
+                # is mandatory, and every rider requeues for a fresh
+                # prefill (which re-derives all activations from the
+                # prompt, healing a transient flip).
+                check_finite(finite, "serving", "decode")
             if self.watchdog is not None:
                 # Hang classification AFTER the host sees results: a chunk
                 # past max_step_seconds raises HangFault into the branch
@@ -908,18 +989,20 @@ class ContinuousScheduler:
                 self.watchdog.observe("decode", extra_s=injected_hang,
                                       classify=not first_compile)
         except Exception as e:  # noqa: BLE001 — containment is the point
-            hang = isinstance(e, HangFault)
+            kind = ("hang" if isinstance(e, HangFault)
+                    else "numerics" if isinstance(e, NumericsFault)
+                    else "device")
             logger.warning("decode chunk failed: %s", e)
             get_registry().counter(
                 "faults_total", component="serving",
-                kind="hang" if hang else "device", stage="decode",
+                kind=kind, stage="decode",
             ).inc()
             if self.breakers is not None:
                 self.breakers.record_failure("decode")
             for slot in live_ids:
                 req = self.pool.release(slot).request
                 self._requeue_or_fail(req, f"decode failed: {e}", stats,
-                                      cause="hang" if hang else "device")
+                                      cause=kind)
             # Every live slot was just released, so nothing in the cache is
             # still needed — rebuild device state from scratch (with TPU
             # buffer donation, a raised call may have consumed the inputs).

@@ -1,4 +1,4 @@
-"""Train-state checkpointing via orbax.
+"""Train-state checkpointing via orbax, with integrity-verified restore.
 
 The reference's only persistence is raw-recommendation JSONs with no load path
 (SURVEY.md §5.4); the sweep side of that is handled by ``pipeline/results.py``.
@@ -6,6 +6,14 @@ This module covers the model/optimizer side: sharded ``TrainState`` save and
 restore (restore re-places each tensor onto its mesh sharding), so a training
 run survives preemption — standard practice for TPU jobs, which are
 preemptible by design.
+
+Integrity (``integrity/manifest.py``): each saved step gets a sha256 manifest
+of its files, written OUTSIDE the orbax step directory
+(``manifest_<step>.json`` at the checkpoint root — orbax owns its step dirs'
+contents). Restore verifies the chosen step first and falls back to the
+next-older step on a digest mismatch or a failed restore — the same ladder
+the phase-results resume uses, because resuming a corrupt train state is
+strictly worse than losing a few steps of progress.
 """
 
 from __future__ import annotations
@@ -16,6 +24,11 @@ from typing import Optional
 
 import jax
 
+from fairness_llm_tpu.integrity.manifest import (
+    IntegrityError,
+    verify_manifest,
+    write_manifest,
+)
 from fairness_llm_tpu.train.step import TrainState
 
 logger = logging.getLogger(__name__)
@@ -30,6 +43,10 @@ def _manager(directory: str):
     )
 
 
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"manifest_{step}.json")
+
+
 def save_train_state(directory: str, state: TrainState, step: Optional[int] = None) -> None:
     import orbax.checkpoint as ocp
 
@@ -37,6 +54,25 @@ def save_train_state(directory: str, state: TrainState, step: Optional[int] = No
     step = int(state.step) if step is None else step
     mgr.save(step, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
+    step_dir = os.path.join(os.path.abspath(directory), str(step))
+    if os.path.isdir(step_dir):
+        write_manifest(step_dir, path=_manifest_path(directory, step))
+    # max_to_keep evicts old steps; drop their orphaned manifests too, so
+    # the directory never accumulates manifests for checkpoints that are
+    # gone (and a future save at a recycled step number starts clean).
+    kept = {int(s) for s in mgr.all_steps()}
+    root = os.path.abspath(directory)
+    for fname in os.listdir(root):
+        if fname.startswith("manifest_") and fname.endswith(".json"):
+            try:
+                s = int(fname[len("manifest_"):-len(".json")])
+            except ValueError:
+                continue
+            if s not in kept:
+                try:
+                    os.unlink(os.path.join(root, fname))
+                except OSError:
+                    pass
     logger.info("saved train state at step %d to %s", step, directory)
 
 
@@ -44,12 +80,19 @@ def restore_train_state(
     directory: str, template: TrainState, step: Optional[int] = None
 ) -> Optional[TrainState]:
     """Restore the latest (or given) step; ``template`` supplies the tree
-    structure and per-leaf shardings (pass a freshly built state)."""
+    structure and per-leaf shardings (pass a freshly built state).
+
+    Steps whose manifest fails verification — or whose restore raises — are
+    skipped with a warning and the next-older step is tried; None when no
+    step restores (resume must not be WORSE than starting over)."""
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory)
-    step = mgr.latest_step() if step is None else step
-    if step is None:
+    if step is not None:
+        candidates = [step]
+    else:
+        candidates = sorted((int(s) for s in mgr.all_steps()), reverse=True)
+    if not candidates:
         return None
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None))
@@ -57,6 +100,28 @@ def restore_train_state(
         else x,
         template,
     )
-    restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    logger.info("restored train state step %d from %s", step, directory)
-    return restored
+    for s in candidates:
+        manifest = _manifest_path(directory, s)
+        step_dir = os.path.join(os.path.abspath(directory), str(s))
+        if os.path.exists(manifest):
+            try:
+                verify_manifest(step_dir, manifest_path=manifest,
+                                kind="train_checkpoint")
+            except IntegrityError as e:
+                logger.warning(
+                    "train checkpoint step %d failed integrity check (%s); "
+                    "trying an older step", s, e,
+                )
+                continue
+        try:
+            restored = mgr.restore(s, args=ocp.args.StandardRestore(abstract))
+        except Exception as e:  # noqa: BLE001 — fall back past a bad step
+            logger.warning(
+                "restore of train checkpoint step %d failed (%s); trying an "
+                "older step", s, e,
+            )
+            continue
+        logger.info("restored train state step %d from %s", s, directory)
+        return restored
+    logger.warning("no restorable train checkpoint under %s", directory)
+    return None

@@ -43,6 +43,20 @@ class HangFault(DecodeFault):
     tell a hang from an ordinary decode fault."""
 
 
+class NumericsFault(DecodeFault):
+    """A chunk whose logits contained NaN/Inf, caught by the on-device
+    numerics guard (``integrity/numerics.py``) before its tokens could be
+    delivered.
+
+    Subclasses ``DecodeFault`` for the same reason ``HangFault`` does: the
+    scheduler releases the chunk's slots and requeues each rider once (a
+    fresh prefill re-derives every activation, so a transient flip heals),
+    ``with_failure_containment`` retries the engine chunk once then emits
+    ``None`` sentinels, and the breakers see a persistent numeric sickness
+    as consecutive failures. The distinct type keys the telemetry labels
+    (``kind="numerics"``) and the ``numerics_faults_total`` breakdown."""
+
+
 class ScriptedFaultInjector:
     """Deterministic fault injection for serving tests and chaos drills.
 
@@ -57,6 +71,15 @@ class ScriptedFaultInjector:
     feeds to the step watchdog as extra elapsed time — a watchdog-classified
     ``HangFault`` without ever sleeping, so hang containment is testable in
     milliseconds.
+
+    ``corruptions`` (same key scheme) scripts SILENT CORRUPTION: each
+    ``maybe_corrupt`` hit tells the scheduler to poison that request's
+    carried logits (``corruption_mode``: "nan" or "inf") before the next
+    decode chunk — so the on-device numerics guard
+    (``integrity/numerics.py``) has something real to catch on the CPU
+    harness, with no device fault hardware required. ``flip_bit`` is the
+    at-rest sibling: one flipped bit in an artifact file, for manifest
+    drills.
     """
 
     def __init__(
@@ -64,12 +87,21 @@ class ScriptedFaultInjector:
         faults: Optional[Dict[object, int]] = None,
         hangs: Optional[Dict[object, int]] = None,
         hang_seconds: float = 3600.0,
+        corruptions: Optional[Dict[object, int]] = None,
+        corruption_mode: str = "nan",
     ):
+        if corruption_mode not in ("nan", "inf"):
+            raise ValueError(
+                f"corruption_mode must be 'nan' or 'inf', got {corruption_mode!r}"
+            )
         self._budget = dict(faults or {})
         self._hang_budget = dict(hangs or {})
+        self._corruption_budget = dict(corruptions or {})
+        self.corruption_mode = corruption_mode
         self.hang_seconds = float(hang_seconds)
         self.fired: List[tuple] = []  # (request_id, stage) audit log
         self.hangs_fired: List[tuple] = []
+        self.corruptions_fired: List[tuple] = []
 
     def maybe_fail(self, request_id: str, stage: str) -> None:
         for key in ((request_id, stage), request_id):
@@ -102,6 +134,44 @@ class ScriptedFaultInjector:
                 ).inc()
                 return self.hang_seconds
         return 0.0
+
+    def maybe_corrupt(self, request_id: str, stage: str) -> Optional[str]:
+        """Corruption mode ("nan"/"inf") the scheduler should poison this
+        request's carried logits with before the next compiled step — None
+        almost always. Consumes one corruption budget per hit. The poison
+        happens host-side on the carry (not inside the program), so the
+        guarded program itself stays the production one."""
+        for key in ((request_id, stage), request_id):
+            n = self._corruption_budget.get(key, 0)
+            if n > 0:
+                self._corruption_budget[key] = n - 1
+                self.corruptions_fired.append((request_id, stage))
+                get_registry().counter(
+                    "faults_total", component="serving",
+                    kind="injected_corruption", stage=stage,
+                ).inc()
+                return self.corruption_mode
+        return None
+
+    @staticmethod
+    def flip_bit(path: str, bit_index: int) -> None:
+        """Flip one bit of a file in place — the scripted cosmic ray for
+        artifact-corruption drills. Pair with an integrity manifest
+        (``integrity/manifest.py``): the flipped file must then be refused
+        at load with an error naming it."""
+        with open(path, "r+b") as f:
+            f.seek(bit_index // 8)
+            byte = f.read(1)
+            if not byte:
+                raise ValueError(
+                    f"bit_index {bit_index} beyond end of {path}"
+                )
+            f.seek(bit_index // 8)
+            f.write(bytes([byte[0] ^ (1 << (bit_index % 8))]))
+        get_registry().counter(
+            "faults_total", component="integrity", kind="injected_bitflip",
+            stage="artifact",
+        ).inc()
 
 
 def with_failure_containment(

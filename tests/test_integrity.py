@@ -1,0 +1,431 @@
+"""Integrity subsystem tests: numerics guards, verified artifacts, canary.
+
+The acceptance contract (ISSUE 5): injected NaN logits are contained as
+``NumericsFault`` (retried, never delivered), a bit-flipped weight shard is
+refused at load with a manifest-digest error naming the file, a canary
+mismatch trips the breaker degradation ladder — and, fault-free, the guards
+and canary change NOTHING: token-for-token identical output with them on or
+off.
+"""
+
+import json
+import math
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import (
+    IntegrityConfig,
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+    SpeculationConfig,
+)
+from fairness_llm_tpu.integrity import (
+    CanaryProbe,
+    IntegrityError,
+    build_manifest,
+    check_finite,
+    masked_finite,
+    verify_manifest,
+    verify_manifest_entry,
+    write_manifest,
+)
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.resilience import BreakerBoard
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import ContinuousScheduler, Request, ServingBackend
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.utils.failures import (
+    NumericsFault,
+    ScriptedFaultInjector,
+    with_failure_containment,
+)
+
+GREEDY = ModelSettings(temperature=0.0, max_tokens=8)
+SCFG = ServingConfig(
+    enabled=True, num_slots=2, queue_capacity=64,
+    max_prompt_len=192, max_new_tokens=32, decode_chunk=4,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0,
+                        numerics_guards=True)
+
+
+@pytest.fixture(scope="module")
+def plain_engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+def _poisoned_engine():
+    """An engine whose every forward emits NaN logits (poisoned final
+    norm), guards armed — the deterministic stand-in for device-side
+    numeric corruption."""
+    eng = DecodeEngine(get_model_config("tiny-test"), seed=0,
+                       numerics_guards=True)
+    eng.params["final_norm"]["scale"] = jnp.full_like(
+        eng.params["final_norm"]["scale"], jnp.nan
+    )
+    return eng
+
+
+# -- numerics guard -----------------------------------------------------------
+
+
+def test_masked_finite_counts_live_rows_only():
+    x = jnp.array([[1.0, 2.0], [jnp.nan, 3.0]])
+    assert bool(masked_finite(x))is False
+    assert bool(masked_finite(x, live=jnp.array([True, False])))
+    assert not bool(masked_finite(x, live=jnp.array([False, True])))
+
+
+def test_check_finite_counts_and_raises():
+    with use_registry() as reg:
+        check_finite(True, "engine", "decode")  # healthy: silent
+        with pytest.raises(NumericsFault, match="engine decode"):
+            check_finite(False, "engine", "decode")
+        c = reg.peek("numerics_faults_total", component="engine",
+                     stage="decode")
+        assert c is not None and c.value == 1
+
+
+def test_engine_guard_greedy_parity(engine, plain_engine):
+    """The guard only ADDS a reduction: tokens identical with it on or off,
+    on both the plain and the speculative path."""
+    prompts = ["hello there", "the quick brown fox jumps"]
+    a = plain_engine.generate(prompts, GREEDY)
+    b = engine.generate(prompts, GREEDY)
+    np.testing.assert_array_equal(a.tokens, b.tokens)
+    spec = SpeculationConfig(enabled=True)
+    a2 = plain_engine.generate(prompts, GREEDY, speculation=spec)
+    b2 = engine.generate(prompts, GREEDY, speculation=spec)
+    np.testing.assert_array_equal(a2.tokens, b2.tokens)
+
+
+def test_engine_guard_compile_keys_disjoint(engine, plain_engine):
+    """Guarded programs must never reuse an unguarded compiled step (their
+    return arity differs); the flag lives in the compile key."""
+    engine.generate(["hi"], GREEDY)
+    plain_engine.generate(["hi"], GREEDY)
+    assert any(k[0] == "decode" and k[-1] is True
+               for k in engine._compiled if isinstance(k, tuple))
+    assert any(k[0] == "decode" and k[-1] is False
+               for k in plain_engine._compiled if isinstance(k, tuple))
+
+
+def test_engine_nan_logits_raise_numerics_fault():
+    eng = _poisoned_engine()
+    with use_registry() as reg:
+        with pytest.raises(NumericsFault):
+            eng.generate(["hello"], GREEDY)
+        c = reg.peek("numerics_faults_total", component="engine",
+                     stage="decode")
+        assert c is not None and c.value == 1
+
+
+def test_engine_nan_contained_to_sentinels():
+    """NumericsFault flows through the standard chunk containment: retry
+    once, then None sentinels — a poisoned sweep degrades to visible gaps,
+    never to corrupt records."""
+    eng = _poisoned_engine()
+
+    def gen(prompts, settings=None, seed=0, keys=None, prefix_ids=None):
+        return eng.generate(prompts, GREEDY, seed=seed).texts
+
+    with use_registry() as reg:
+        out = with_failure_containment(gen)(["a", "b"])
+        assert out == [None, None]
+        c = reg.peek("contained_chunk_failures_total", component="pipeline",
+                     error_type="NumericsFault")
+        assert c is not None and c.value == 2  # initial + one retry
+
+
+def test_spec_numerics_fault_feeds_speculate_breaker():
+    """A numerically-sick speculative path must accumulate breaker failures
+    (and eventually shed) — success may only be recorded once the chunk's
+    finite flag passed, or a persistent NaN source would reset the count
+    every call and the breaker would never open."""
+    eng = _poisoned_engine()
+    eng.breakers = BreakerBoard(failure_threshold=2, cooldown_s=60.0,
+                                component="engine")
+    spec = SpeculationConfig(enabled=True)
+    with use_registry():
+        for _ in range(2):
+            with pytest.raises(NumericsFault):
+                eng.generate(["one two three one two"], GREEDY,
+                             speculation=spec)
+        assert eng.breakers.state("speculate") == "open"
+
+
+def test_scheduler_nan_injection_contained_with_parity(engine):
+    """An injected NaN faults the whole chunk as NumericsFault; every rider
+    requeues once (fresh prefill re-derives the activations) and decodes
+    clean tokens — greedy parity with the uninterrupted engine."""
+    prompts = {"r0": "hello there", "r1": "the quick brown fox",
+               "r2": "abc abc abc"}
+    baseline = {rid: engine.generate([p], GREEDY).tokens[0]
+                for rid, p in prompts.items()}
+    with use_registry() as reg:
+        inj = ScriptedFaultInjector(corruptions={("r1", "decode"): 1})
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=GREEDY, fault_injector=inj,
+            resilience=ResilienceConfig(enabled=True),
+        )
+        results = {r.id: r for r in sched.serve(
+            [Request(prompt=p, id=rid, settings=GREEDY)
+             for rid, p in prompts.items()]
+        )}
+        assert inj.corruptions_fired == [("r1", "decode")]
+        for rid, ref in baseline.items():
+            res = results[rid]
+            n = len(res.tokens)
+            assert res.ok, (rid, res.finish_reason, res.error)
+            assert np.array_equal(np.asarray(res.tokens), ref[:n])
+            assert np.all(ref[n:] == engine.tokenizer.pad_id)
+        assert sched.last_stats.requeued >= 1
+        c = reg.peek("numerics_faults_total", component="serving",
+                     stage="decode")
+        assert c is not None and c.value == 1
+        rq = reg.peek("serving_requeues_by_cause_total", component="serving",
+                      cause="numerics")
+        assert rq is not None and rq.value >= 1
+
+
+def test_scheduler_poisoned_prefill_fails_loudly():
+    """Permanently-poisoned params: the PREFILL guard refuses every attempt
+    and the requests terminate failed (requeue-once, then a Result naming
+    the fault) — contained, never silently garbage."""
+    eng = _poisoned_engine()
+    with use_registry() as reg:
+        sched = ContinuousScheduler(eng, SCFG, settings=GREEDY)
+        results = sched.serve([
+            Request(prompt="hello there", id="p0", settings=GREEDY)
+        ])
+        assert not results[0].ok
+        assert results[0].finish_reason == "failed"
+        assert "non-finite" in results[0].error
+        c = reg.peek("numerics_faults_total", component="serving",
+                     stage="prefill")
+        assert c is not None and c.value == 2  # first attempt + requeue
+
+
+def test_injector_corruption_budget():
+    inj = ScriptedFaultInjector(corruptions={"r": 2}, corruption_mode="inf")
+    with use_registry():
+        assert inj.maybe_corrupt("r", "decode") == "inf"
+        assert inj.maybe_corrupt("r", "decode") == "inf"
+        assert inj.maybe_corrupt("r", "decode") is None
+        assert inj.corruptions_fired == [("r", "decode")] * 2
+    with pytest.raises(ValueError):
+        ScriptedFaultInjector(corruption_mode="garbage")
+
+
+# -- manifests ----------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_bitflip(tmp_path):
+    d = tmp_path / "artifact"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"\x00" * 1024)
+    (d / "sub").mkdir()
+    (d / "sub" / "b.txt").write_text("hello")
+    write_manifest(str(d))
+    verify_manifest(str(d), kind="test")  # clean round-trip
+    with use_registry() as reg:
+        ScriptedFaultInjector.flip_bit(str(d / "a.bin"), 500 * 8 + 3)
+        with pytest.raises(IntegrityError, match="a.bin"):
+            verify_manifest(str(d), kind="test")
+        assert reg.peek("manifest_failures_total", kind="test").value == 1
+        ScriptedFaultInjector.flip_bit(str(d / "a.bin"), 500 * 8 + 3)  # undo
+    verify_manifest(str(d), kind="test")  # healthy again
+    # a listed-but-missing file is also a failure naming the file
+    os.unlink(d / "sub" / "b.txt")
+    with pytest.raises(IntegrityError, match="b.txt"):
+        verify_manifest(str(d), kind="test")
+
+
+def test_manifest_entry_fallback_semantics(tmp_path):
+    """verify_manifest_entry is the FALL BACK discipline: True for
+    unlisted/unmanifested files (pre-manifest artifacts keep loading),
+    False — not raise — on a real mismatch."""
+    d = str(tmp_path)
+    (tmp_path / "x.json").write_text("{}")
+    assert verify_manifest_entry(d, "x.json")  # no manifest at all
+    from fairness_llm_tpu.integrity.manifest import update_manifest_entry
+
+    update_manifest_entry(d, "x.json")
+    assert verify_manifest_entry(d, "x.json")
+    (tmp_path / "y.json").write_text("{}")
+    assert verify_manifest_entry(d, "y.json")  # unlisted file
+    (tmp_path / "x.json").write_text('{"tampered": 1}')
+    with use_registry():
+        assert not verify_manifest_entry(d, "x.json")
+
+
+def test_weights_manifest_refuses_bitflip(tmp_path):
+    """The acceptance criterion verbatim: a bit-flipped weight shard is
+    refused at load with a manifest-digest error naming the file."""
+    from fairness_llm_tpu.runtime.weights import (
+        load_checkpoint,
+        save_checkpoint_hf,
+    )
+
+    cfg = get_model_config("tiny-test")
+    eng = DecodeEngine(cfg, seed=0)
+    d = str(tmp_path / "ckpt")
+    save_checkpoint_hf(cfg, eng.params, d)
+    manifest = build_manifest(d)
+    entry = manifest["files"]["model.safetensors"]
+    assert entry.get("num_tensors", 0) > 0  # shape/dtype summary present
+    load_checkpoint(cfg, d)  # clean load passes verification
+    shard = os.path.join(d, "model.safetensors")
+    with use_registry():
+        # flip deep in the tensor-data region: safetensors itself would
+        # accept these bytes — only the digest can catch it
+        ScriptedFaultInjector.flip_bit(shard, (os.path.getsize(shard) - 64) * 8)
+        with pytest.raises(IntegrityError, match="model.safetensors"):
+            load_checkpoint(cfg, d)
+    # explicit opt-out still loads (the bytes parse; values are just wrong)
+    load_checkpoint(cfg, d, verify=False)
+
+
+def test_train_checkpoint_falls_back_past_corrupt_step(tmp_path):
+    """Digest mismatch on the newest train-state step resumes from the
+    next-older valid one — same ladder as the results resume."""
+    import jax
+
+    from fairness_llm_tpu.train import make_train_step
+    from fairness_llm_tpu.train.checkpoint import (
+        restore_train_state,
+        save_train_state,
+    )
+
+    cfg = get_model_config("tiny-test")
+    init_state, step = make_train_step(cfg)
+    state = init_state(jax.random.key(0))
+    tokens = np.random.default_rng(0).integers(3, 512, (4, 8)).astype(np.int32)
+    valid = np.ones((4, 8), bool)
+    state, _ = step(state, tokens, valid)  # step 1
+    save_train_state(str(tmp_path), state)
+    state2, _ = step(state, tokens, valid)  # step 2
+    save_train_state(str(tmp_path), state2)
+    # corrupt a payload file of the NEWEST step (2)
+    step_dir = tmp_path / "2"
+    victims = [p for p in step_dir.rglob("*") if p.is_file() and p.stat().st_size > 0]
+    assert victims
+    ScriptedFaultInjector.flip_bit(str(victims[0]), 8)
+    with use_registry():
+        template = init_state(jax.random.key(1))
+        restored = restore_train_state(str(tmp_path), template)
+    assert restored is not None
+    assert int(restored.step) == 1  # fell back past the corrupt step 2
+
+
+# -- results: strict JSON + sanitization --------------------------------------
+
+
+def test_save_results_sanitizes_nan_to_null(tmp_path):
+    """Fairness metrics can be NaN (empty group); the written JSON must be
+    STRICT (no bare NaN tokens) with the sanitized key paths recorded in
+    metadata — and the caller's in-memory dict untouched."""
+    from fairness_llm_tpu.pipeline import results as R
+
+    payload = {
+        "metadata": {"phase": 1},
+        "metrics": {
+            "dp": {"score": float("nan"), "groups": [1.0, float("inf"), 2.0]},
+            "ok": 0.5,
+        },
+    }
+    path = str(tmp_path / "phase1_results.json")
+    R.save_results(payload, path)
+    # caller's dict untouched
+    assert math.isnan(payload["metrics"]["dp"]["score"])
+    assert "sanitized_non_finite" not in payload["metadata"]
+    raw = open(path).read()
+
+    def reject_constants(name):  # strict parser: bare NaN/Infinity refused
+        raise ValueError(f"non-JSON constant {name}")
+
+    data = json.loads(raw, parse_constant=reject_constants)
+    assert data["metrics"]["dp"]["score"] is None
+    assert data["metrics"]["dp"]["groups"][1] is None
+    assert data["metrics"]["ok"] == 0.5
+    assert sorted(data["metadata"]["sanitized_non_finite"]) == [
+        "metrics.dp.groups[1]", "metrics.dp.score",
+    ]
+
+
+def test_save_results_updates_manifest(tmp_path):
+    from fairness_llm_tpu.pipeline import results as R
+
+    path = str(tmp_path / "phase1" / "phase1_results.json")
+    R.save_results({"metrics": {"x": 1.0}}, path)
+    manifest = json.load(open(tmp_path / "phase1" / "manifest.json"))
+    assert "phase1_results.json" in manifest["files"]
+    assert verify_manifest_entry(str(tmp_path / "phase1"),
+                                 "phase1_results.json")
+
+
+# -- parsing satellite --------------------------------------------------------
+
+
+def test_parse_comma_list_strips_markdown_emphasis():
+    """The comma parser must clean items exactly like the numbered parser
+    (shared _clean_item): markdown bold/emphasis and quotes stripped."""
+    from fairness_llm_tpu.pipeline.parsing import (
+        parse_comma_list,
+        parse_numbered_list,
+    )
+
+    text = '**The Matrix**, "Alien", *Heat*, Up'
+    assert parse_comma_list(text) == ["The Matrix", "Alien", "Heat", "Up"]
+    numbered = "1. **The Matrix**\n2. \"Alien\"\n3. *Heat*\n4. Up"
+    assert parse_numbered_list(numbered) == parse_comma_list(text)
+
+
+# -- canary -------------------------------------------------------------------
+
+
+def test_canary_match_then_mismatch_trips_ladder(engine):
+    board = BreakerBoard(failure_threshold=3, cooldown_s=60.0)
+    sched = ContinuousScheduler(engine, SCFG, settings=GREEDY, breakers=board)
+    with use_registry() as reg:
+        probe = CanaryProbe.record(engine, max_tokens=8, every_n=2,
+                                   board=board)
+        assert not probe.tick() and probe.tick()  # every_n cadence
+        assert probe.probe(sched)
+        assert board.ladder.level == 0
+        # tampered reference == silently-wrong serving output, as seen from
+        # the comparator's side
+        probe.reference = probe.reference.copy()
+        probe.reference[0] += 1
+        assert not probe.probe(sched)
+        assert board.state("decode") == "open"
+        assert board.ladder.level >= 1
+        assert reg.peek("canary_runs_total", component="serving").value == 2
+        assert reg.peek("canary_mismatch_total",
+                        component="serving").value == 1
+
+
+def test_backend_canary_parity(engine):
+    """Canary on vs off: byte-identical backend output (the probe rides
+    between batches, never inside them)."""
+    prompts = ["hello there", "the quick brown fox"]
+    base = ServingBackend(engine, SCFG)
+    expected = base.generate(prompts, GREEDY, keys=["a", "b"])
+    with use_registry():
+        be = ServingBackend(
+            engine, SCFG,
+            resilience=ResilienceConfig(enabled=True),
+            integrity=IntegrityConfig(numerics_guards=True, canary_every_n=1,
+                                      canary_max_tokens=8),
+        )
+        got = be.generate(prompts, GREEDY, keys=["a", "b"])
+        assert be._canary is not None  # armed and probed
+    assert got == expected

@@ -308,6 +308,33 @@ def test_journal_tolerates_torn_tail(tmp_path):
     assert [r["id"] for r in j2.unfinished()] == ["j0"]
 
 
+def test_checkpoint_resume_falls_back_past_digest_mismatch(tmp_path):
+    """The integrity sibling of the torn-write regressions
+    (tests/test_pipeline.py::test_resume_falls_back_past_torn_checkpoint):
+    a checkpoint whose bytes were silently corrupted AFTER an atomic write
+    still parses as valid JSON — only its manifest digest can tell — and
+    resume must fall back to the next-older VALID checkpoint, exactly like
+    it does for a torn one."""
+    from fairness_llm_tpu.pipeline import results as R
+
+    good = {"p1": {"recommendations": ["A"], "raw_response": "1. A"}}
+    R.save_checkpoint(good, str(tmp_path), "phase1", 7)
+    evil = {"p1": {"recommendations": ["WRONG"], "raw_response": "1. WRONG"},
+            "p2": {"recommendations": ["ALSO WRONG"], "raw_response": "x"}}
+    R.save_checkpoint(evil, str(tmp_path), "phase1", 14)
+    # Bit-rot AFTER the write: swap the newest checkpoint's bytes for
+    # different-but-valid JSON without touching the manifest. Every
+    # pre-integrity fallback reason (unreadable, wrong shape, all-errors)
+    # would accept this file; only the digest refuses it.
+    path = R.checkpoint_path(str(tmp_path), "phase1", 14)
+    with open(path, "w") as f:
+        json.dump({"completed": 14, "recommendations": evil}, f)
+    with use_registry() as reg:
+        assert R.load_latest_checkpoint(str(tmp_path), "phase1") == good
+        c = reg.peek("manifest_failures_total", kind="results")
+        assert c is not None and c.value == 1
+
+
 # -- graceful drain -----------------------------------------------------------
 
 

@@ -1,22 +1,32 @@
-"""Chaos drill: scripted faults + an injected hang + a real mid-run SIGTERM,
-then resume — the end-to-end proof behind docs/RESILIENCE.md.
+"""Chaos drill: scripted faults + injected hang + silent corruption + a real
+mid-run SIGTERM, then resume — the end-to-end proof behind
+docs/RESILIENCE.md (resilience AND integrity layers).
 
 What it does, in one process, deterministically:
 
-1. builds a tiny CPU engine and records an UNINTERRUPTED baseline (the
-   greedy tokens every request should decode);
+1. builds a tiny CPU engine (numerics guards armed) and records an
+   UNINTERRUPTED baseline (the greedy tokens every request should decode);
 2. re-serves the same workload through a resilience-armed scheduler with a
    scripted fault mix (one transient decode fault, one permanent one, one
    prefill fault), one injected hang (watchdog-classified, no real sleep),
-   and a journal — and raises a REAL ``SIGTERM`` at itself the moment the
-   late cohort reaches decode, so the ``GracefulDrain`` handler drains the
-   run mid-flight;
+   one injected NaN corruption (guard-classified ``NumericsFault``), and a
+   journal — and raises a REAL ``SIGTERM`` at itself the moment the late
+   cohort reaches decode, so the ``GracefulDrain`` handler drains the run
+   mid-flight;
 3. resumes the journal's unfinished requests (``resume_serving``) in a
    fresh scheduler;
-4. validates the ISSUE-4 acceptance: every request terminal (zero lost),
-   survivors token-for-token equal to the baseline, the decode breaker's
-   closed -> open -> half-open -> closed cycle present in the telemetry
-   snapshot, the hang counted, and the journal empty.
+4. drills the at-rest integrity path: exports the engine's weights with a
+   sha256 manifest, flips one BIT in the shard, and asserts the load is
+   refused with an error naming the file;
+5. drills the canary: a golden-prompt probe through a live scheduler
+   matches its static-engine reference, then a tampered reference
+   (standing in for silently-corrupt serving output) trips the decode
+   breaker and the degradation ladder;
+6. validates the ISSUE-4/5 acceptance: every request terminal (zero lost),
+   survivors token-for-token equal to the baseline (zero corrupt records —
+   the NaN chunk was retried, not delivered), the breaker cycle + hang +
+   numerics fault + manifest failure + canary mismatch all present in the
+   telemetry snapshot, and the journal empty.
 
 Usage (CI runs exactly this):
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --telemetry-dir chaos-tel
@@ -61,6 +71,7 @@ PROMPTS = {
     "doomed": "abc abc abc abc abc",    # permanent decode fault -> failed
     "pfault": "one two three one two",  # one prefill fault
     "hangme": "recommend ten films please",  # one injected hang
+    "nanme": "name five good books",    # one injected NaN corruption
     "late0": "zz zz zz",                # reaching decode triggers SIGTERM
     "late1": "a long prompt that shifts padding and lands in a bucket",
 }
@@ -72,8 +83,8 @@ class SigtermOnSight(ScriptedFaultInjector):
     serve) turns it into a drain request the scheduler honors at its next
     loop iteration. Deterministic 'preemption notice mid-run'."""
 
-    def __init__(self, faults, hangs):
-        super().__init__(faults, hangs=hangs)
+    def __init__(self, faults, hangs, corruptions=None):
+        super().__init__(faults, hangs=hangs, corruptions=corruptions)
         self._fired_sigterm = False
 
     def maybe_fail(self, request_id, stage):
@@ -103,7 +114,10 @@ def main() -> int:
         if not ok:
             problems.append(what)
 
-    engine = DecodeEngine(get_model_config("tiny-test"), seed=0)
+    # Numerics guards armed: the injected NaN below must be caught by the
+    # on-device finite flag, not delivered as garbage tokens.
+    engine = DecodeEngine(get_model_config("tiny-test"), seed=0,
+                          numerics_guards=True)
 
     # 1. Uninterrupted baseline: the tokens every survivor must reproduce.
     baseline = {}
@@ -117,6 +131,7 @@ def main() -> int:
         faults={("flaky", "decode"): 1, ("doomed", "decode"): 2,
                 ("pfault", "prefill"): 1},
         hangs={("hangme", "decode"): 1},
+        corruptions={("nanme", "decode"): 1},
     )
     sched = ContinuousScheduler(engine, SERVING, settings=GREEDY,
                                 fault_injector=inj, resilience=RESILIENCE,
@@ -131,6 +146,8 @@ def main() -> int:
     check(set(results) == set(PROMPTS), "every request got a phase-1 Result")
     check(bool(preempted), "SIGTERM drained a late cohort to the journal")
     check(inj.hangs_fired == [("hangme", "decode")], "the hang fired once")
+    check(inj.corruptions_fired == [("nanme", "decode")],
+          "the NaN corruption fired once")
     check(sorted(r["id"] for r in journal.unfinished()) == preempted,
           "journal unfinished == preempted set")
 
@@ -157,8 +174,58 @@ def main() -> int:
                 or not np.all(ref[n:] == engine.tokenizer.pad_id):
             parity_ok = False
             print(f"  parity break: {rid}: {list(res.tokens)} vs {list(ref)}")
-    check(parity_ok and survivors >= len(PROMPTS) - 2,
+    # Survivor floor: each chunk-wide fault (decode fault, hang, NaN chunk)
+    # requeues BOTH riders of the 2-slot pool, so with five scripted faults
+    # a rider can legitimately burn its single requeue on someone else's
+    # fault and terminate failed — terminal and visible, never lost or
+    # corrupt. 5-of-8 is this script's deterministic outcome; the hard
+    # guarantees are the per-request checks around it.
+    check(parity_ok and survivors >= len(PROMPTS) - 3,
           f"{survivors} survivors all token-for-token with baseline")
+    nan_res = final["nanme"]
+    check(nan_res.ok and np.array_equal(
+              np.asarray(nan_res.tokens),
+              baseline["nanme"][: len(nan_res.tokens)]),
+          "NaN-corrupted request contained + retried to clean tokens")
+
+    # 4. At-rest integrity: a bit-flipped weight shard must be REFUSED at
+    # load with a manifest-digest error naming the file.
+    from fairness_llm_tpu.integrity.manifest import IntegrityError  # noqa: E402
+    from fairness_llm_tpu.runtime.weights import (  # noqa: E402
+        load_checkpoint,
+        save_checkpoint_hf,
+    )
+
+    wdir = tempfile.mkdtemp(prefix="chaos-weights-")
+    save_checkpoint_hf(engine.config, engine.params, wdir)
+    shard = os.path.join(wdir, "model.safetensors")
+    # Clean round-trip first: the manifest must accept what it just hashed.
+    load_checkpoint(engine.config, wdir)
+    # Flip one bit deep in the tensor data region (past the header).
+    ScriptedFaultInjector.flip_bit(shard, (os.path.getsize(shard) - 64) * 8)
+    try:
+        load_checkpoint(engine.config, wdir)
+        check(False, "bit-flipped shard refused at load")
+    except IntegrityError as e:
+        check("model.safetensors" in str(e),
+              f"bit-flipped shard refused, error names the file ({e})")
+
+    # 5. Canary: golden-prompt probe through a live scheduler matches the
+    # static-engine reference; a tampered reference (the comparator's view
+    # of silently-corrupt serving output) trips the degradation ladder.
+    from fairness_llm_tpu.integrity.canary import CanaryProbe  # noqa: E402
+    from fairness_llm_tpu.resilience import BreakerBoard  # noqa: E402
+
+    board = BreakerBoard(failure_threshold=3, cooldown_s=60.0)
+    canary_sched = ContinuousScheduler(engine, SERVING, settings=GREEDY,
+                                       breakers=board)
+    probe = CanaryProbe.record(engine, max_tokens=8, every_n=1, board=board)
+    check(probe.probe(canary_sched), "canary matches on a healthy scheduler")
+    probe.reference = probe.reference.copy()
+    probe.reference[0] += 1  # silent corruption, as the comparator sees it
+    check(not probe.probe(canary_sched) and board.state("decode") == "open"
+          and board.ladder.level >= 1,
+          "canary mismatch trips the breaker degradation ladder")
 
     snap = T.snapshot(T.get_registry())
     trans = {
@@ -174,6 +241,11 @@ def main() -> int:
     pre = [c for c in snap["counters"]
            if c["name"] == "serving_preempted_total" and c["value"] > 0]
     check(bool(pre), "serving_preempted_total > 0 in snapshot")
+    for name in ("numerics_faults_total", "manifest_failures_total",
+                 "canary_runs_total", "canary_mismatch_total"):
+        hits = [c for c in snap["counters"]
+                if c["name"] == name and c["value"] > 0]
+        check(bool(hits), f"{name} > 0 in snapshot")
 
     if a.telemetry_dir:
         path = T.write_snapshot(T.get_registry(), a.telemetry_dir)

@@ -6,6 +6,7 @@ Usage:
     python tools/validate_telemetry.py <telemetry-dir-or-snapshot.json>
     python tools/validate_telemetry.py <path> --require-serving
     python tools/validate_telemetry.py <path> --require-breaker
+    python tools/validate_telemetry.py <path> --require-integrity
 
 Plain mode checks the schema only (`cli telemetry-report --validate` does
 the same inline). ``--require-serving`` additionally requires nonzero TTFT,
@@ -14,6 +15,9 @@ the CI smoke step's gate after a ``--continuous --telemetry-dir`` run of the
 tiny CPU study. ``--require-breaker`` requires the resilience signals the
 chaos smoke step produces: breaker_state gauges, a full
 closed->open->half-open->closed transition cycle, and a counted hang.
+``--require-integrity`` requires the silent-corruption signals the extended
+chaos drill produces: a counted NumericsFault, a manifest digest failure,
+and a canary run with at least one mismatch.
 """
 
 from __future__ import annotations
@@ -30,9 +34,22 @@ REQUIRED_SERVING_HISTOGRAMS = ("ttft_s", "queue_wait_s", "per_output_token_s")
 
 
 def check(path: str, require_serving: bool = False,
-          require_breaker: bool = False) -> int:
+          require_breaker: bool = False,
+          require_integrity: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
+    if require_integrity:
+        counters = snap.get("counters", [])
+
+        def total(name):
+            return sum(c["value"] for c in counters if c.get("name") == name)
+
+        for name in ("numerics_faults_total", "manifest_failures_total",
+                     "canary_runs_total", "canary_mismatch_total"):
+            if not total(name):
+                problems.append(
+                    f"{name} is zero (integrity drill didn't exercise it)"
+                )
     if require_breaker:
         gauges = [g for g in snap.get("gauges", [])
                   if g.get("name") == "breaker_state"]
@@ -84,9 +101,11 @@ def main() -> int:
     ap.add_argument("path")
     ap.add_argument("--require-serving", action="store_true")
     ap.add_argument("--require-breaker", action="store_true")
+    ap.add_argument("--require-integrity", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
-                 require_breaker=a.require_breaker)
+                 require_breaker=a.require_breaker,
+                 require_integrity=a.require_integrity)
 
 
 if __name__ == "__main__":
