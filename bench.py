@@ -433,6 +433,123 @@ def measure_integrity_overhead(engine, prompts, settings_cls) -> dict | None:
     return out
 
 
+def measure_fleet(engine, prompts, settings_cls) -> dict | None:
+    """2-replica fleet router vs a single scheduler, plus failover timing.
+
+    Two measurements (ISSUE 6):
+
+    - **Fault-free overhead**: the same mixed-length workload through one
+      ``ContinuousScheduler`` with N slots vs a 2-replica ``ReplicaSet``
+      with N/2 slots each — same TOTAL concurrency, so the delta is the
+      router itself (health scoring, per-replica bookkeeping, the
+      interleaved step loop). Target: within the CPU harness's run-to-run
+      noise (±30-60% single-run jitter; best-of-3 per mode in one
+      process, per docs/PERFORMANCE.md methodology). Token parity between
+      the two modes is asserted on the workload just decoded.
+    - **Failover recovery**: re-run with a scripted ``replica_crash`` on
+      r1 mid-sweep and report fence -> first migrated token
+      (``ReplicaSet.last_failover_s``), migrated count, and that zero
+      requests were lost.
+    """
+    import numpy as np
+
+    from fairness_llm_tpu.config import (
+        FleetConfig,
+        IntegrityConfig,
+        ResilienceConfig,
+        ServingConfig,
+        default_config,
+    )
+    from fairness_llm_tpu.serving import ContinuousScheduler, ReplicaSet, Request
+    from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+    num_slots = max(default_config().decode_batch_size, 2)
+    per_replica = num_slots // 2
+    n_requests = 2 * num_slots
+    budgets = [16, 32, 48, 64]
+    workload = _mixed_workload(engine, prompts, n_requests,
+                               targets=[32, 64, 128, 256], budgets=budgets)
+
+    def greedy(m):
+        return _greedy(settings_cls, m)
+
+    def scfg(slots):
+        return ServingConfig(
+            enabled=True, num_slots=slots, max_prompt_len=512,
+            max_new_tokens=max(budgets), decode_chunk=8,
+        )
+
+    res = ResilienceConfig(enabled=True, breaker_threshold=3,
+                           breaker_cooldown_s=0.05)
+
+    def run(server, tag):
+        reqs = [
+            Request(prompt=p, id=f"fleet_{tag}_{i:04d}", settings=greedy(b))
+            for i, (p, b) in enumerate(workload)
+        ]
+        t0 = time.perf_counter()
+        results = server.serve(reqs)
+        wall = time.perf_counter() - t0
+        assert all(r.ok for r in results)
+        toks = [tuple(int(t) for t in r.tokens) for r in results]
+        return wall, toks
+
+    out = {"num_requests": n_requests, "total_slots": num_slots,
+           "replicas": 2, "slots_per_replica": per_replica}
+    tokens = {}
+    single = ContinuousScheduler(engine, scfg(num_slots),
+                                 settings=greedy(max(budgets)))
+    fleet = ReplicaSet(engine, scfg(per_replica), settings=greedy(max(budgets)),
+                       fleet=FleetConfig(replicas=2), resilience=res)
+    for tag, server in (("single", single), ("fleet", fleet)):
+        run(server, tag)  # warmup: compile prefill buckets + step programs
+        wall, toks = min((run(server, tag) for _ in range(3)),
+                         key=lambda r: r[0])
+        tokens[tag] = toks
+        total = sum(len(t) for t in toks)
+        out[tag] = {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(total / wall, 1),
+        }
+    # The router must never change the tokens — fleet greedy parity is the
+    # zero-loss contract's other half, asserted on what was just decoded.
+    assert tokens["fleet"] == tokens["single"], "fleet routing changed output"
+    out["router_overhead_ratio"] = round(
+        out["fleet"]["wall_s"] / out["single"]["wall_s"], 3
+    )
+
+    # Failover: crash r1 a few health polls in, measure recovery.
+    inj = ScriptedFaultInjector(replica_crashes={"r1": 4})
+    crash_fleet = ReplicaSet(
+        engine, scfg(per_replica), settings=greedy(max(budgets)),
+        fleet=FleetConfig(replicas=2, fence_cooldown_s=0.1),
+        resilience=res, fault_injector=inj,
+        integrity=IntegrityConfig(canary_max_tokens=8),
+    )
+    reqs = [Request(prompt=p, id=f"failover_{i:04d}", settings=greedy(b))
+            for i, (p, b) in enumerate(workload)]
+    t0 = time.perf_counter()
+    results = crash_fleet.serve(reqs)
+    wall = time.perf_counter() - t0
+    rejoined = crash_fleet.await_recovery(timeout_s=60.0)
+    from fairness_llm_tpu.telemetry import get_registry
+
+    out["failover"] = {
+        "wall_s": round(wall, 3),
+        "crash_fired": inj.replica_faults_fired == [("r1", "replica_crash")],
+        "zero_lost": all(r.ok for r in results),
+        "migrated_requests": int(get_registry().read_value(
+            "fleet_migrated_requests_total", component="fleet")),
+        "recovery_s_fence_to_first_migrated_token": (
+            round(crash_fleet.last_failover_s, 4)
+            if crash_fleet.last_failover_s is not None else None
+        ),
+        "crashed_replica_rejoined": rejoined,
+    }
+    assert out["failover"]["zero_lost"], "failover lost requests"
+    return out
+
+
 def measure_achievable_gbps() -> float | None:
     """This chip's ACHIEVABLE streaming bandwidth, measured in-run.
 
@@ -989,6 +1106,17 @@ def _run() -> None:
         print(f"integrity overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Replica-fleet A/B (ISSUE 6): 2-replica health-routed fleet vs a
+    # single scheduler at the same total slot count (router overhead must
+    # stay within harness noise), plus failover recovery time under an
+    # injected replica crash (fence -> first migrated token).
+    fleet = None
+    try:
+        fleet = measure_fleet(engine, prompts, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"fleet A/B skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -1317,6 +1445,7 @@ def _run() -> None:
             "continuous": continuous,
             "resilience_overhead": resilience,
             "integrity_overhead": integrity,
+            "fleet": fleet,
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
             "large_sweep_int8w_int8kv": large_sweep_int8w,

@@ -133,6 +133,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="with --continuous: concurrent KV slots "
                         "(= decode-step batch rows)")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="with --continuous: serve through N data-parallel "
+                        "engine replicas behind a health-aware router "
+                        "(serving/fleet.py) — each replica gets its own KV "
+                        "slot pool, breakers, watchdog, and rejoin canary; "
+                        "a sick replica is fenced and drained, its requests "
+                        "migrate to healthy replicas with original "
+                        "ids/settings/row-seeds (greedy parity preserved), "
+                        "and it rejoins only after a canary warm-up probe. "
+                        "See docs/SERVING.md §Replica fleet")
+    p.add_argument("--fence-level", type=int, default=None,
+                   help="with --replicas: degradation-ladder level at which "
+                        "the router fences a replica (default 2 = "
+                        "reduced_footprint; 0 disables ladder-driven "
+                        "fencing — crash/hang/stall still fence)")
+    p.add_argument("--fence-cooldown", type=float, default=None,
+                   help="with --replicas: seconds a fenced replica waits "
+                        "before its first canary rejoin probe (default 1; "
+                        "probes additionally defer until the replica's "
+                        "open breakers can half-open, so the effective "
+                        "delay is max of this and --breaker-cooldown)")
     p.add_argument("--max-step-seconds", type=float, default=None,
                    help="resilience watchdog: a compiled prefill/decode step "
                         "slower than this is classified HUNG and contained "
@@ -248,6 +269,28 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--slots must be >= 1")
             serve_kwargs["num_slots"] = args.slots
         updates["serving"] = ServingConfig(**serve_kwargs)
+    fleet_flags = (args.replicas, args.fence_level, args.fence_cooldown)
+    if any(v is not None for v in fleet_flags):
+        from fairness_llm_tpu.config import FleetConfig
+
+        if not args.continuous:
+            raise SystemExit("--replicas/--fence-level/--fence-cooldown "
+                             "require --continuous (the fleet routes over "
+                             "serving schedulers)")
+        fleet_kwargs: Dict = {}
+        if args.replicas is not None:
+            if args.replicas < 1:
+                raise SystemExit("--replicas must be >= 1")
+            fleet_kwargs["replicas"] = args.replicas
+        if args.fence_level is not None:
+            if args.fence_level < 0:
+                raise SystemExit("--fence-level must be >= 0")
+            fleet_kwargs["fence_ladder_level"] = args.fence_level
+        if args.fence_cooldown is not None:
+            if args.fence_cooldown < 0:
+                raise SystemExit("--fence-cooldown must be >= 0")
+            fleet_kwargs["fence_cooldown_s"] = args.fence_cooldown
+        updates["fleet"] = FleetConfig(**fleet_kwargs)
     resilience_flags = (args.max_step_seconds, args.breaker_threshold,
                         args.breaker_cooldown, args.serving_journal,
                         args.drain_grace)

@@ -81,6 +81,46 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Data-parallel replica fleet knobs (``serving/fleet.py``).
+
+    ``replicas`` > 1 puts a :class:`ReplicaSet` behind the serving backend:
+    N independent engine replicas — each with its own KV slot pool,
+    scheduler, BreakerBoard, watchdog, and rejoin canary — fed from one
+    bounded admission queue by a health-aware router
+    (``serving/router.py``). Replica-level fault containment is the point:
+    a replica whose degradation ladder climbs past ``fence_ladder_level``
+    (or whose stall probe fires, or that takes an injected
+    replica_crash/replica_hang) is FENCED — drained through the journal
+    path with zero grace, its unfinished requests re-routed to healthy
+    replicas with their original ids/settings/row_seeds so survivors keep
+    token-for-token greedy parity — and rejoins only after passing a
+    canary warm-up probe once ``fence_cooldown_s`` elapses (half-open at
+    fleet granularity, mirroring the per-stage breaker state machine).
+
+    ``fence_cooldown_s`` is the EARLIEST rejoin probe; the probe decodes
+    through the fenced replica's own breakers, so when those are still
+    open inside their own ``breaker_cooldown_s`` the fleet defers the
+    probe until they can half-open (probing earlier would block the
+    single-threaded fleet loop against a refusing stage). The effective
+    rejoin delay is therefore max(fence_cooldown_s, remaining breaker
+    cooldown).
+    """
+
+    replicas: int = 1  # 1 = single engine, no fleet layer
+    # Degradation level at which the router fences a replica (2 =
+    # reduced_footprint: the replica has already shed speculation AND
+    # halved its footprint — past that, migrating its work beats letting
+    # it limp). 0 disables ladder-driven fencing (crash/hang/stall still
+    # fence).
+    fence_ladder_level: int = 2
+    # Simultaneously-open stage breakers that fence regardless of ladder
+    # level (2 = both prefill and decode dead).
+    fence_open_breakers: int = 2
+    fence_cooldown_s: float = 1.0  # fenced -> first rejoin-probe delay
+
+
+@dataclasses.dataclass(frozen=True)
 class ResilienceConfig:
     """Watchdog / circuit-breaker / graceful-drain knobs (``resilience/``).
 
@@ -239,6 +279,11 @@ class Config:
     # batch shape lose nothing, and the static path remains the reference
     # numerics). --continuous on the CLI flips enabled.
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # Replica fleet: data-parallel engine replicas behind a health-aware
+    # router (--replicas N; needs --continuous). A sick replica is fenced
+    # and drained, its requests migrate to healthy replicas, and it
+    # rejoins through a canary probe. See docs/SERVING.md §Replica fleet.
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     # Resilience: step watchdog + per-stage circuit breakers + graceful
     # drain/journal (off by default; --max-step-seconds/--serving-journal
     # and friends flip it on). See docs/RESILIENCE.md.

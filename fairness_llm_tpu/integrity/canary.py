@@ -32,7 +32,7 @@ the serving path is byte-identical (pinned in tests/test_integrity.py).
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Mapping, Optional
 
 import numpy as np
 
@@ -56,6 +56,7 @@ class CanaryProbe:
         every_n: int = 32,
         board=None,
         component: str = "serving",
+        labels: Optional[Mapping[str, str]] = None,
     ):
         self.prompt = prompt
         # The full engine token row ([max_new], pad-filled after EOS): the
@@ -66,12 +67,16 @@ class CanaryProbe:
         self.every_n = int(every_n)
         self.board = board
         self.component = component
+        # Extra instrument labels: a fleet replica's rejoin canary passes
+        # {"replica": name}, so per-replica canary health reads apart from
+        # the backend-level probe's.
+        self.labels = dict(labels or {})
         self._calls = 0
         self._seq = 0
         # Gauge exists from construction: a healthy snapshot still shows
         # the canary was armed (1 ok / 0 mismatch / -1 never probed).
         get_registry().gauge(
-            "canary_last_ok", component=component
+            "canary_last_ok", component=component, **self.labels
         ).set(-1)
 
     @classmethod
@@ -92,6 +97,17 @@ class CanaryProbe:
         return cls(
             prompt, out.tokens[0], settings, engine.tokenizer.pad_id,
             every_n=every_n, board=board, component=component,
+        )
+
+    def for_replica(self, replica: str, board=None) -> "CanaryProbe":
+        """A sibling probe sharing this probe's recorded reference, with
+        per-replica labels and the REPLICA's own breaker board — the fleet
+        rejoin gate (``serving/fleet.py``): one static-engine record, one
+        probe per replica, so N replicas never pay N reference decodes."""
+        return CanaryProbe(
+            self.prompt, self.reference, self.settings, self.pad_id,
+            every_n=0, board=board, component=self.component,
+            labels={"replica": replica},
         )
 
     def tick(self) -> bool:
@@ -121,16 +137,20 @@ class CanaryProbe:
             and np.all(self.reference[n:] == self.pad_id)
         )
         reg = get_registry()
-        reg.counter("canary_runs_total", component=self.component).inc()
-        reg.gauge("canary_last_ok", component=self.component).set(1 if ok else 0)
+        reg.counter("canary_runs_total", component=self.component,
+                    **self.labels).inc()
+        reg.gauge("canary_last_ok", component=self.component,
+                  **self.labels).set(1 if ok else 0)
         if ok:
             return True
-        reg.counter("canary_mismatch_total", component=self.component).inc()
+        reg.counter("canary_mismatch_total", component=self.component,
+                    **self.labels).inc()
         emit_event(
             "canary_mismatch", component=self.component,
             finish_reason=res.finish_reason,
             got=[int(t) for t in got[:8]],
             expected=[int(t) for t in self.reference[:8]],
+            **self.labels,
         )
         logger.error(
             "canary mismatch: golden prompt decoded %s (expected prefix of "
@@ -147,12 +167,6 @@ class CanaryProbe:
         whole failure budget at once. Recovery stays the breaker's own
         half-open probe — the ladder walks back down when real traffic (or
         the next canary) decodes correctly again."""
-        if self.board is None:
+        if self.board is None or "decode" not in self.board.breakers:
             return
-        breaker = self.board.breakers.get("decode")
-        if breaker is None:
-            return
-        from fairness_llm_tpu.resilience.breaker import OPEN
-
-        while breaker.state != OPEN:
-            breaker.record_failure()
+        self.board.trip("decode")

@@ -46,7 +46,7 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from fairness_llm_tpu.telemetry import emit_event, get_registry
 
@@ -71,6 +71,7 @@ class CircuitBreaker:
         component: str = "serving",
         clock: Callable[[], float] = time.monotonic,
         on_transition: Optional[Callable[[str, str, str], None]] = None,
+        labels: Optional[Mapping[str, str]] = None,
     ):
         if failure_threshold < 1:
             raise ValueError(
@@ -80,6 +81,10 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.cooldown_s = float(cooldown_s)
         self.component = component
+        # Extra instrument labels (a fleet replica's board passes
+        # {"replica": name} so per-replica breaker state never aliases);
+        # empty for the single-engine path — metric keys unchanged.
+        self.labels = dict(labels or {})
         self.clock = clock
         self.on_transition = on_transition
         self.state = CLOSED
@@ -88,7 +93,7 @@ class CircuitBreaker:
         # Gauge exists (at 0 = closed) from construction, so a snapshot of a
         # healthy run still shows the breaker was armed.
         get_registry().gauge(
-            "breaker_state", component=component, stage=stage
+            "breaker_state", component=component, stage=stage, **self.labels
         ).set(_STATE_CODE[CLOSED])
 
     def _transition(self, new: str) -> None:
@@ -97,12 +102,13 @@ class CircuitBreaker:
             self.opened_at = self.clock()
         reg = get_registry()
         reg.gauge("breaker_state", component=self.component,
-                  stage=self.stage).set(_STATE_CODE[new])
+                  stage=self.stage, **self.labels).set(_STATE_CODE[new])
         reg.counter("breaker_transitions_total", component=self.component,
-                    stage=self.stage, to=new).inc()
+                    stage=self.stage, to=new, **self.labels).inc()
         emit_event("breaker_transition", component=self.component,
                    stage=self.stage, from_state=old, to_state=new,
-                   consecutive_failures=self.consecutive_failures)
+                   consecutive_failures=self.consecutive_failures,
+                   **self.labels)
         logger.warning("breaker[%s/%s]: %s -> %s", self.component, self.stage,
                        old, new)
         if self.on_transition is not None:
@@ -125,7 +131,7 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         get_registry().counter("breaker_failures_total",
                                component=self.component,
-                               stage=self.stage).inc()
+                               stage=self.stage, **self.labels).inc()
         if self.state == HALF_OPEN:
             # The probe failed: straight back to open, cooldown restarts.
             self.consecutive_failures += 1
@@ -164,10 +170,13 @@ class DegradationLadder:
         "normal", "no_speculation", "reduced_footprint", "static_fallback"
     )
 
-    def __init__(self, component: str = "serving"):
+    def __init__(self, component: str = "serving",
+                 labels: Optional[Mapping[str, str]] = None):
         self.component = component
+        self.labels = dict(labels or {})
         self.level = 0
-        get_registry().gauge("degradation_level", component=component).set(0)
+        get_registry().gauge("degradation_level", component=component,
+                             **self.labels).set(0)
 
     @property
     def rung(self) -> str:
@@ -179,11 +188,13 @@ class DegradationLadder:
             return
         old, self.level = self.level, level
         reg = get_registry()
-        reg.gauge("degradation_level", component=self.component).set(level)
+        reg.gauge("degradation_level", component=self.component,
+                  **self.labels).set(level)
         reg.counter("degradation_transitions_total", component=self.component,
-                    to=self.RUNGS[level]).inc()
+                    to=self.RUNGS[level], **self.labels).inc()
         emit_event("degradation", component=self.component,
-                   from_level=old, to_level=level, rung=self.RUNGS[level])
+                   from_level=old, to_level=level, rung=self.RUNGS[level],
+                   **self.labels)
         log = logger.warning if level > old else logger.info
         log("degradation[%s]: level %d (%s) -> %d (%s)", self.component,
             old, self.RUNGS[old], level, self.RUNGS[level])
@@ -207,13 +218,14 @@ class BreakerBoard:
         component: str = "serving",
         clock: Callable[[], float] = time.monotonic,
         stages: Tuple[str, ...] = STAGES,
+        labels: Optional[Mapping[str, str]] = None,
     ):
-        self.ladder = DegradationLadder(component=component)
+        self.ladder = DegradationLadder(component=component, labels=labels)
         self.breakers: Dict[str, CircuitBreaker] = {
             stage: CircuitBreaker(
                 stage, failure_threshold=failure_threshold,
                 cooldown_s=cooldown_s, component=component, clock=clock,
-                on_transition=self._on_transition,
+                on_transition=self._on_transition, labels=labels,
             )
             for stage in stages
         }
@@ -240,6 +252,20 @@ class BreakerBoard:
 
     def state(self, stage: str) -> str:
         return self.breakers[stage].state
+
+    def open_count(self) -> int:
+        """Stages currently refusing work — a fleet-router fence input."""
+        return sum(1 for b in self.breakers.values() if b.state == OPEN)
+
+    def trip(self, stage: str) -> None:
+        """Force one stage's breaker open — for detectors with DIRECT
+        evidence the stage is dead (a canary mismatch, a replica crash
+        signal), which spend the whole failure budget at once instead of
+        accumulating consecutive faults. Recovery stays the breaker's own
+        half-open probe."""
+        breaker = self.breakers[stage]
+        while breaker.state != OPEN:
+            breaker.record_failure()
 
     def seconds_until_probe(self, stage: str) -> Optional[float]:
         return self.breakers[stage].seconds_until_probe

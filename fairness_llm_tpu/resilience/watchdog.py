@@ -35,20 +35,25 @@ Hangs are injectable without real sleeps: ``ScriptedFaultInjector``
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Mapping, Optional
 
 from fairness_llm_tpu.telemetry import emit_event, get_registry
 from fairness_llm_tpu.utils.failures import HangFault
 
 # The gauge the scheduler/engine loops stamp after every completed compiled
-# step; ``stalled()`` reads it back. One gauge per component label.
+# step; ``stalled()`` reads it back. One gauge per (component, labels) — a
+# fleet replica's watchdog stamps its own gauge, so one hung replica's
+# stall probe can fire while its siblings' gauges stay fresh.
 LAST_STEP_GAUGE = "step_last_completed_ts"
 
 
-def mark_step_completed(component: str, clock: Callable[[], float] = time.monotonic) -> None:
+def mark_step_completed(component: str,
+                        clock: Callable[[], float] = time.monotonic,
+                        labels: Optional[Mapping[str, str]] = None) -> None:
     """Stamp the shared liveness gauge (monotonic clock — ``stalled()``
     computes durations from it, never wall-clock math)."""
-    get_registry().gauge(LAST_STEP_GAUGE, component=component).set(clock())
+    get_registry().gauge(LAST_STEP_GAUGE, component=component,
+                         **(labels or {})).set(clock())
 
 
 class StepWatchdog:
@@ -64,9 +69,15 @@ class StepWatchdog:
         max_step_seconds: float,
         component: str = "serving",
         clock: Callable[[], float] = time.monotonic,
+        labels: Optional[Mapping[str, str]] = None,
     ):
         self.max_step_seconds = float(max_step_seconds)
         self.component = component
+        # Extra instrument labels ({"replica": name} for fleet replicas) —
+        # both the written histograms/gauges and the liveness gauge
+        # ``stalled()`` reads back use them, keeping each replica's
+        # liveness its own.
+        self.labels = dict(labels or {})
         self.clock = clock
         self._armed: Dict[str, float] = {}  # stage -> arm timestamp
 
@@ -106,16 +117,17 @@ class StepWatchdog:
         total = float(elapsed) + float(extra_s)
         reg = get_registry()
         reg.histogram("step_wall_s", component=self.component,
-                      stage=stage).observe(total)
-        reg.gauge("watchdog_last_step_s", component=self.component).set(total)
-        mark_step_completed(self.component, self.clock)
+                      stage=stage, **self.labels).observe(total)
+        reg.gauge("watchdog_last_step_s", component=self.component,
+                  **self.labels).set(total)
+        mark_step_completed(self.component, self.clock, self.labels)
         if self.max_step_seconds > 0 and total > self.max_step_seconds \
                 and (classify or extra_s > 0):
             reg.counter("watchdog_hangs_total", component=self.component,
-                        stage=stage).inc()
+                        stage=stage, **self.labels).inc()
             emit_event("watchdog_hang", component=self.component, stage=stage,
                        step_s=round(total, 3),
-                       max_step_seconds=self.max_step_seconds)
+                       max_step_seconds=self.max_step_seconds, **self.labels)
             raise HangFault(
                 f"{self.component} {stage} step took {total:.3f}s "
                 f"(> max_step_seconds {self.max_step_seconds:g})"
@@ -129,7 +141,8 @@ class StepWatchdog:
         raise, does not require this object to be the one arming steps."""
         # peek, not gauge(): an observer must not create a zero-valued gauge
         # (which would read as "last step at t=0 = stalled forever").
-        g = get_registry().peek(LAST_STEP_GAUGE, component=self.component)
+        g = get_registry().peek(LAST_STEP_GAUGE, component=self.component,
+                                **self.labels)
         if g is None or not g.value:
             return None
         now = self.clock() if now is None else now

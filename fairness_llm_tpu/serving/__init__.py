@@ -2,19 +2,26 @@
 
 Layers (bottom-up): ``request`` (Request/Result wire format) -> ``queue``
 (bounded admission + rate limiting) -> ``slots`` (KV slot pool allocator)
--> ``scheduler`` (the prefill/decode step loop) -> ``backend`` (the
-``DecodeBackend`` adapter the pipeline phases consume). See docs/SERVING.md.
+-> ``scheduler`` (the prefill/decode step loop) -> ``router``/``fleet``
+(health-aware routing over N replica schedulers, per-replica fault domains
+with fence/migrate/rejoin) -> ``backend`` (the ``DecodeBackend`` adapter
+the pipeline phases consume). See docs/SERVING.md.
 """
 
 from fairness_llm_tpu.serving.backend import ServingBackend
+from fairness_llm_tpu.serving.fleet import Replica, ReplicaSet
 from fairness_llm_tpu.serving.queue import AdmissionQueue
 from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 
 __all__ = [
     "AdmissionQueue",
     "ContinuousScheduler",
+    "HealthRouter",
+    "Replica",
+    "ReplicaSet",
     "Request",
     "Result",
     "ServingBackend",

@@ -27,6 +27,12 @@ Differences from ``EngineBackend`` that callers should know:
   (``integrity/canary.py``) decodes through the live scheduler every N
   generate calls, compared token-for-token against a static-engine
   reference; a mismatch trips the decode breaker and the ladder above.
+- with ``fleet.replicas`` > 1 (CLI ``--replicas N``), each sampler tuple
+  gets a :class:`ReplicaSet` (``serving/fleet.py``) instead of a single
+  scheduler: N replica fault domains behind a health-aware router, where
+  a sick replica is fenced/drained/migrated instead of degrading the
+  whole backend — resilience state is then per-replica, and the
+  static-fallback rung above is replaced by fence/rejoin.
 """
 
 from __future__ import annotations
@@ -37,6 +43,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from fairness_llm_tpu.config import (
+    FleetConfig,
     IntegrityConfig,
     ModelSettings,
     ResilienceConfig,
@@ -44,6 +51,7 @@ from fairness_llm_tpu.config import (
 )
 from fairness_llm_tpu.resilience.breaker import BreakerBoard
 from fairness_llm_tpu.resilience.drain import ServingJournal
+from fairness_llm_tpu.serving.fleet import ReplicaSet
 from fairness_llm_tpu.serving.request import Request
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.telemetry import get_registry
@@ -60,7 +68,8 @@ class ServingBackend:
                  name: Optional[str] = None, fault_injector=None,
                  resilience: Optional[ResilienceConfig] = None,
                  journal: Optional[ServingJournal] = None,
-                 integrity: Optional[IntegrityConfig] = None):
+                 integrity: Optional[IntegrityConfig] = None,
+                 fleet: Optional[FleetConfig] = None):
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.name = name or engine.config.name
@@ -68,14 +77,30 @@ class ServingBackend:
         self.resilience = resilience
         self.journal = journal
         self.integrity = integrity
+        # Replica fleet (serving/fleet.py): fleet.replicas > 1 makes
+        # scheduler_for build a ReplicaSet per sampler tuple instead of a
+        # single scheduler — N fault domains behind the health-aware
+        # router, sharing this backend's engine params.
+        self.fleet = fleet if (fleet is not None and fleet.replicas > 1) \
+            else None
+        self._fleet_seq = 0  # ReplicaSets built by this backend, ever
         # Canary probe (integrity/canary.py): built lazily on the first
         # generate() — recording its reference costs one static-engine
         # decode, which must not land in backend construction (weight
         # loading time for big models).
         self._canary = None
         self._canary_sched = None
+        self._canary_calls = 0  # fleet-mode tick counter (no CanaryProbe)
         self.board: Optional[BreakerBoard] = None
-        if resilience is not None and resilience.enabled:
+        if self.fleet is not None:
+            # Fleet mode: resilience state is PER-REPLICA (each replica's
+            # scheduler builds its own BreakerBoard/watchdog, labeled
+            # {"replica": name}), and the last containment rung is the
+            # fleet's fence/migrate/rejoin instead of this backend's
+            # static-engine fallback — one shared board would re-couple
+            # the fault domains the fleet exists to separate.
+            pass
+        elif resilience is not None and resilience.enabled:
             # ONE board for the whole backend: every scheduler's prefill/
             # decode breakers and the engine's speculate gate share state,
             # so the ladder sees the process's health, not one sampler
@@ -99,27 +124,43 @@ class ServingBackend:
 
     def scheduler_for(self, settings: ModelSettings) -> ContinuousScheduler:
         """One scheduler per sampler tuple (sampling is compiled into the
-        step program). The persistent KV pool is the scheduler's dominant
-        memory, so only a small working set is kept (LRU, like the engine's
-        prefix-KV cache)."""
+        step program) — or one :class:`ReplicaSet` per tuple in fleet mode
+        (the fleet presents the same ``serve``/``last_stats`` surface).
+        The persistent KV pool is the scheduler's dominant memory, so only
+        a small working set is kept (LRU, like the engine's prefix-KV
+        cache)."""
         key = (settings.temperature, settings.top_k, settings.top_p)
         sched = self._schedulers.get(key)
         if sched is not None:
             self._schedulers[key] = self._schedulers.pop(key)  # LRU refresh
             return sched
-        sched = ContinuousScheduler(
-            self.engine, self.serving, settings=settings,
-            fault_injector=self.fault_injector,
-            resilience=self.resilience, journal=self.journal,
-            breakers=self.board,
-        )
+        if self.fleet is not None:
+            # The backend's FIRST fleet keeps the default r0/r1 labels;
+            # later sampler tuples get a namespacing name ("s1", ...) so
+            # two fleets' replicas never alias instruments (liveness
+            # gauges, healthy-replica counts) in one registry.
+            sched = ReplicaSet(
+                self.engine, self.serving, settings=settings,
+                fleet=self.fleet, resilience=self.resilience,
+                journal=self.journal, fault_injector=self.fault_injector,
+                integrity=self.integrity,
+                name=None if self._fleet_seq == 0 else f"s{self._fleet_seq}",
+            )
+            self._fleet_seq += 1
+        else:
+            sched = ContinuousScheduler(
+                self.engine, self.serving, settings=settings,
+                fault_injector=self.fault_injector,
+                resilience=self.resilience, journal=self.journal,
+                breakers=self.board,
+            )
         keys = list(self._schedulers)
         while len(keys) >= 2:
             del self._schedulers[keys.pop(0)]
         self._schedulers[key] = sched
         return sched
 
-    def _maybe_canary(self) -> None:
+    def _maybe_canary(self, live_sched=None) -> None:
         """Arm (lazily) and run the canary probe when due: every
         ``integrity.canary_every_n`` generate calls, the golden prompt
         decodes through the live scheduler and is compared token-for-token
@@ -130,6 +171,17 @@ class ServingBackend:
         it."""
         integ = self.integrity
         if integ is None or integ.canary_every_n <= 0:
+            return
+        if isinstance(live_sched, ReplicaSet):
+            # Fleet mode: the probe must be attributable to a replica (and
+            # trip THAT replica's board) or a mismatch would contain
+            # nothing — ReplicaSet.periodic_canary probes one unfenced
+            # replica of the fleet serving THIS call, round-robin, with
+            # per-replica references/boards/labels (greedy fleets only;
+            # it no-ops where no deterministic reference exists).
+            self._canary_calls += 1
+            if self._canary_calls % integ.canary_every_n == 0:
+                live_sched.periodic_canary()
             return
         if self._canary is None:
             from fairness_llm_tpu.integrity.canary import CanaryProbe
@@ -224,7 +276,7 @@ class ServingBackend:
             self.last_output = out
             return list(out.texts)
         sched = self.scheduler_for(settings)
-        self._maybe_canary()
+        self._maybe_canary(sched)
         requests = []
         for i, p in enumerate(prompts):
             if keys is not None:

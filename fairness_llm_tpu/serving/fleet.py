@@ -1,0 +1,685 @@
+"""Replica fleet: N engine replicas behind one bounded admission queue.
+
+Everything below the fleet protects exactly ONE engine: the watchdog, the
+per-stage breakers, the degradation ladder, the numerics guards, the canary
+— all of it keeps one scheduler's loop alive, and a single hung or
+NaN-poisoned engine still takes the whole serving stack down with it. The
+``ReplicaSet`` is the next containment boundary up — *a sick replica
+drains, not the fleet*:
+
+- **N independent replicas**, each a full serving stack of its own: its own
+  ``ContinuousScheduler`` (KV slot pool + compiled programs), its own
+  ``BreakerBoard`` + degradation ladder, its own step watchdog, and its own
+  rejoin canary — every instrument labeled ``{"replica": name}`` so one
+  registry holds N distinguishable health states. Replicas may share one
+  engine's params (the CPU-harness shape: one weight tree, N slot pools) or
+  carry one engine each (the multi-chip topology this scaffolds — ROADMAP
+  item 2(b) plugs real-mesh TP=8 engines into exactly this seam, and 2(c)
+  splits prefill/decode replicas over it).
+- **Health-aware routing** (``serving/router.py``): admissions pop from the
+  fleet's bounded ``AdmissionQueue`` and land on the healthiest,
+  least-loaded replica — breaker states, ladder level, canary freshness,
+  and queue-depth high-water marks all discount a replica's share, so a
+  struggling replica sheds traffic *before* it needs fencing.
+- **Fencing**: a replica whose ladder climbs past
+  ``FleetConfig.fence_ladder_level``, whose open-breaker count reaches
+  ``fence_open_breakers``, whose stall probe fires, or that takes an
+  injected ``replica_crash``/``replica_hang`` is FENCED: drained through
+  the existing ``GracefulDrain``/journal path with **zero grace** (a sick
+  replica must not keep decoding work that should migrate), its breakers
+  forced open for crash-class reasons, and every unfinished request
+  **migrated** — re-routed to healthy replicas with its ORIGINAL id,
+  settings, and row_seed, so survivors keep token-for-token greedy parity
+  (the same identity contract ``resume-serving`` relies on). Migration
+  resets the per-request retry budget: the requeue-once rule is a
+  per-replica fault domain, and a request that burned its retry on a dying
+  replica's fault gets a fresh budget on a healthy one.
+- **Canary-gated rejoin**: a fenced replica is half-open at fleet
+  granularity, mirroring the per-stage breaker machine — after
+  ``fence_cooldown_s`` it must pass a warm-up probe (greedy workloads: a
+  golden-prompt decode through its own scheduler, token-compared against
+  one shared static-engine reference; sampled workloads: a smoke decode)
+  before taking traffic again. A failed probe re-fences and restarts the
+  cooldown. The probe's decode is itself the replica's breakers' half-open
+  probe, so rejoin and breaker recovery are one motion.
+- **Zero-loss accounting**: every request accepted by ``serve`` either
+  reaches a terminal ``Result`` or survives in the (fleet-shared) journal
+  — a process-wide ``GracefulDrain`` drains every replica with the
+  configured grace and preempts the fleet-held tail, exactly like the
+  single-scheduler contract.
+
+Fleet telemetry: ``fleet_replicas`` / ``fleet_healthy_replicas`` gauges,
+``fleet_fenced_total{replica,reason}`` / ``fleet_rejoins_total{replica}`` /
+``fleet_migrated_requests_total`` / ``fleet_migrated_recovered_total``
+counters, and ``fleet_failover_recovery_s`` (fence -> first migrated
+token) — ``tools/validate_telemetry.py --require-fleet`` gates a drill on
+them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+from fairness_llm_tpu.config import (
+    FleetConfig,
+    IntegrityConfig,
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+)
+from fairness_llm_tpu.resilience.drain import ServingJournal, drain_requested
+from fairness_llm_tpu.serving.queue import AdmissionQueue
+from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.router import HealthRouter
+from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.utils.profiling import ServingStats
+from fairness_llm_tpu.utils.ratelimit import RateLimiter
+
+logger = logging.getLogger(__name__)
+
+# Fence reasons that arrive as SIGNALS (injected faults, the stall probe)
+# rather than inferred breaker/ladder state: the replica's serving stages
+# are presumed dead, so its breakers are forced open at fence time and
+# rejoin must pass through their half-open machinery, not just the fleet
+# cooldown timer.
+CRASH_CLASS_REASONS = ("replica_crash", "replica_hang", "stalled")
+
+
+class Replica:
+    """One fault domain: a scheduler (with its own slot pool, board, and
+    watchdog), fence state, and the fleet's bookkeeping of what is
+    currently routed to it."""
+
+    def __init__(self, name: str, engine, sched: ContinuousScheduler):
+        self.name = name
+        self.engine = engine
+        self.sched = sched
+        self.stats = ServingStats(num_slots=sched.num_slots)
+        self.fenced = False
+        self.fenced_at: Optional[float] = None
+        self.fence_reason: Optional[str] = None
+        self.fences = 0
+        self.rejoins = 0
+        # Request ids currently routed here -> their Request objects (the
+        # migration source of truth: Results only carry ids).
+        self.assigned: Dict[str, Request] = {}
+        # Lazily-built rejoin canary (shares the fleet's recorded
+        # reference; see ReplicaSet._rejoin_probe).
+        self.canary = None
+
+
+class ReplicaSet:
+    """N replicas + the router, presenting the ``ContinuousScheduler``
+    surface the ``ServingBackend`` consumes (``serve``, ``last_stats``,
+    ``num_slots``...), so phases run through the fleet unchanged.
+
+    ``engines``: one engine (shared params — every replica gets its own KV
+    pool and compiled programs but streams the same weight tree; the
+    CPU-harness and single-host shape) or a sequence of ``fleet.replicas``
+    engines (one per chip — the production topology).
+    """
+
+    def __init__(
+        self,
+        engines,
+        serving: Optional[ServingConfig] = None,
+        settings: Optional[ModelSettings] = None,
+        fleet: Optional[FleetConfig] = None,
+        resilience: Optional[ResilienceConfig] = None,
+        journal: Optional[ServingJournal] = None,
+        fault_injector=None,
+        integrity: Optional[IntegrityConfig] = None,
+        name: Optional[str] = None,
+    ):
+        # ``name`` namespaces this fleet's instruments when a process runs
+        # MORE THAN ONE ReplicaSet (ServingBackend keeps one per sampler
+        # tuple): replica labels become "<name>.r0" and fleet-level
+        # metrics gain a {"fleet": name} label — without it, two fleets'
+        # r0 watchdogs would stamp one shared liveness gauge (masking a
+        # stall) and overwrite each other's healthy-replica gauge. None
+        # (the default, and always the backend's FIRST fleet) keeps the
+        # plain r0/r1 labels every drill and doc example uses.
+        self.name = name
+        self._fleet_labels = {"fleet": name} if name else {}
+        self.serving = serving or ServingConfig(enabled=True)
+        self.settings = settings or ModelSettings()
+        self.fleet = fleet or FleetConfig(replicas=2)
+        if self.fleet.replicas < 1:
+            raise ValueError(
+                f"fleet.replicas must be >= 1, got {self.fleet.replicas}"
+            )
+        self.resilience = resilience
+        self.journal = journal
+        self.fault_injector = fault_injector
+        self.integrity = integrity or IntegrityConfig()
+        self.router = HealthRouter(self.fleet)
+        if isinstance(engines, (list, tuple)):
+            if len(engines) != self.fleet.replicas:
+                raise ValueError(
+                    f"{len(engines)} engines for {self.fleet.replicas} "
+                    "replicas — pass one engine per replica, or a single "
+                    "engine to share its params"
+                )
+            per_replica = list(engines)
+        else:
+            per_replica = [engines] * self.fleet.replicas
+        # Replica schedulers: rate limiting stays at the FLEET queue (one
+        # quota for the fleet, not N), everything else per-replica.
+        rep_serving = dataclasses.replace(
+            self.serving, admission_per_minute=None
+        )
+        self.replicas: List[Replica] = []
+        for i, eng in enumerate(per_replica):
+            rep_name = f"{name}.r{i}" if name else f"r{i}"
+            sched = ContinuousScheduler(
+                eng, rep_serving, settings=self.settings,
+                fault_injector=fault_injector, resilience=resilience,
+                journal=journal, replica=rep_name,
+            )
+            self.replicas.append(Replica(rep_name, eng, sched))
+        # The fleet's own bounded admission queue — the backpressure
+        # boundary callers see; the router feeds replica queues from it.
+        self.queue = AdmissionQueue(
+            capacity=self.serving.queue_capacity,
+            rate_limiter=(
+                RateLimiter(self.serving.admission_per_minute)
+                if self.serving.admission_per_minute else None
+            ),
+        )
+        self._pending: Deque[Request] = deque()
+        self._migrating: Deque[Request] = deque()
+        self._results: Dict[str, Result] = {}
+        self._migrated_ids: set = set()
+        self._recovered_ids: set = set()
+        self._canary_rr = 0  # periodic-canary round-robin cursor
+        self._rejected_taken = 0
+        self._canary_ref = None  # shared rejoin-canary reference (lazy)
+        self._probe_seq = 0
+        self._fence_t: Optional[float] = None
+        self._failover_pending = False
+        self.last_failover_s: Optional[float] = None
+        self.last_stats: Optional[ServingStats] = None
+        reg = get_registry()
+        reg.gauge("fleet_replicas", component="fleet",
+                  **self._fleet_labels).set(len(self.replicas))
+        reg.gauge("fleet_healthy_replicas", component="fleet",
+                  **self._fleet_labels).set(len(self.replicas))
+
+    # -- ContinuousScheduler-surface compatibility ---------------------------
+
+    @property
+    def num_slots(self) -> int:
+        """Total concurrent KV slots across the fleet (what the backend
+        reports as the decode batch)."""
+        return sum(r.sched.num_slots for r in self.replicas)
+
+    @property
+    def max_prompt_bucket(self) -> int:
+        return self.replicas[0].sched.max_prompt_bucket
+
+    @property
+    def cache_len(self) -> int:
+        return self.replicas[0].sched.cache_len
+
+    @property
+    def healthy_count(self) -> int:
+        return sum(1 for r in self.replicas if not r.fenced)
+
+    # -- serve ---------------------------------------------------------------
+
+    def serve(self, requests: Sequence[Request]) -> List[Result]:
+        """Serve ``requests`` across the fleet; Results come back in
+        submission order. The loop interleaves every replica's scheduler
+        one iteration at a time (``ContinuousScheduler.step``), routing
+        admissions by health, fencing/migrating sick replicas, and probing
+        fenced ones for rejoin — until every request is terminal."""
+        now = time.monotonic()
+        ids = [r.id for r in requests]
+        if len(set(ids)) != len(ids):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            raise ValueError(f"duplicate request ids in serve() batch: {dup}")
+        for req in requests:
+            # Same loud sampler-mismatch guard as the scheduler's, before
+            # any work starts (one fleet = one compiled sampler tuple).
+            self.replicas[0].sched._check_settings(req)
+        for req in requests:
+            req.submitted_at = now
+            if self.journal is not None:
+                # Fleet-level intake ledger: a request preempted while
+                # still fleet-held (never reached a replica scheduler)
+                # must survive for resume-serving too.
+                self.journal.record_submitted(req)
+            self._pending.append(req)
+        expected = set(ids)
+        while not expected.issubset(self._results):
+            if drain_requested():
+                self._drain_all()
+                break
+            if not self._tick():
+                # Nothing moved: every routable replica idle/refused, or
+                # the whole fleet fenced mid-cooldown. Yield instead of
+                # spinning (rejoin probes re-arm on a later tick).
+                time.sleep(0.002)
+        self._finish_stats()
+        out = [self._results.pop(rid) for rid in ids]
+        for rid in ids:
+            self._migrated_ids.discard(rid)
+            self._recovered_ids.discard(rid)
+        return out
+
+    def await_recovery(self, timeout_s: float = 30.0,
+                       poll_s: float = 0.01) -> bool:
+        """Keep probing fenced replicas until the fleet is whole (True) or
+        ``timeout_s`` elapses (False). A fault landing near the end of a
+        sweep leaves its replica fenced at ``serve`` return — drills (and
+        operators waiting to hand traffic back) call this to see the
+        canary-gated rejoin through."""
+        deadline = time.monotonic() + timeout_s
+        while any(r.fenced for r in self.replicas):
+            for rep in self.replicas:
+                if rep.fenced:
+                    self._maybe_rejoin(rep)
+            if not any(r.fenced for r in self.replicas):
+                break
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    # -- the fleet loop ------------------------------------------------------
+
+    def _tick(self) -> bool:
+        progressed = self._expire_held()
+        progressed |= self._route()
+        for rep in self.replicas:
+            if rep.fenced:
+                progressed |= self._maybe_rejoin(rep)
+                continue
+            injected = None
+            injector_fault = getattr(
+                self.fault_injector, "maybe_replica_fault", None
+            )
+            if injector_fault is not None:
+                injected = injector_fault(rep.name)
+            if injected is not None:
+                self._fence(rep, injected)
+                progressed = True
+                continue
+            if rep.sched.has_work:
+                progressed |= rep.sched.step(rep.stats)
+                self._collect(rep)
+            reason = self.router.should_fence(rep)
+            if reason is not None:
+                self._fence(rep, reason)
+                progressed = True
+        return progressed
+
+    def _expire_held(self) -> bool:
+        """Deadline-expire requests still FLEET-held (pending, queued, or
+        awaiting migration) — replica schedulers expire what they hold,
+        but a request stranded while the whole fleet is fenced must still
+        terminate ``deadline``, never hang the serve loop forever."""
+        now = time.monotonic()
+        expired: List[Request] = list(self.queue.drain_expired(now))
+        for held in (self._pending, self._migrating):
+            live = [r for r in held if not r.expired(now)]
+            if len(live) != len(held):
+                expired.extend(r for r in held if r.expired(now))
+                held.clear()
+                held.extend(live)
+        for req in expired:
+            if self.journal is not None:
+                self.journal.record_terminal(req.id, "expired")
+            self._deliver(req.id, Result(
+                id=req.id, ok=False, finish_reason="deadline",
+                error="deadline expired before a healthy replica could "
+                      "take the request", retries=req.retries,
+                latency_s=now - req.submitted_at,
+            ))
+        return bool(expired)
+
+    def _route(self) -> bool:
+        """Feed the fleet queue from pending overflow, then place migrated
+        requests (front of line — they were admitted once already) and
+        queued admissions on the healthiest replicas."""
+        moved = False
+        while self._pending and not self.queue.full:
+            if not self.queue.submit(self._pending[0],
+                                     count_rejection=False):
+                break  # rate-limited; retry next tick
+            self._pending.popleft()
+            moved = True
+        while self._migrating:
+            rep = self.router.pick(self.replicas)
+            if rep is None:
+                break
+            req = self._migrating.popleft()
+            # front=True: a migrated request already waited through its
+            # fenced replica's queue — on the new replica it goes ahead of
+            # work that hasn't, which is also what bounds failover
+            # recovery (fence -> first migrated token) to roughly one
+            # admission+chunk instead of the healthy replica's backlog.
+            # restamp=False everywhere in _route: the deadline/latency
+            # clock started at FLEET intake and must keep running through
+            # routing waits and migrations — never silently extend.
+            if not rep.sched.submit(req, front=True, restamp=False):
+                self._migrating.appendleft(req)
+                break
+            rep.assigned[req.id] = req
+            moved = True
+        while len(self.queue):
+            rep = self.router.pick(self.replicas)
+            if rep is None:
+                break
+            req = self.queue.pop(1)[0]
+            if not rep.sched.submit(req, restamp=False):
+                self.queue.requeue(req)
+                break
+            rep.assigned[req.id] = req
+            moved = True
+        return moved
+
+    def _collect(self, rep: Replica) -> None:
+        """Claim terminal Results for everything routed to ``rep``."""
+        for rid in list(rep.assigned):
+            res = rep.sched.take_result(rid)
+            if res is None:
+                continue
+            del rep.assigned[rid]
+            self._deliver(rid, res, rep=rep)
+
+    def _deliver(self, rid: str, res: Result,
+                 rep: Optional[Replica] = None) -> None:
+        """Hand one terminal Result to the caller-visible set, crediting
+        the migrated==recovered gate ONCE per request: recovered means a
+        migrated request reached a terminal outcome (not lost) — whatever
+        the outcome and wherever it terminated (a healthy replica, a
+        fleet-held deadline expiry, or a process-wide drain's
+        preemption-to-journal). Counting unique requests on both sides is
+        what makes migrated == recovered a real invariant even when a
+        request migrates twice (its first replica's successor fences
+        too)."""
+        self._results[rid] = res
+        if rid in self._migrated_ids and rid not in self._recovered_ids:
+            self._recovered_ids.add(rid)
+            get_registry().counter(
+                "fleet_migrated_recovered_total", component="fleet",
+                **self._fleet_labels,
+            ).inc()
+            self._record_failover(rep, res)
+
+    def _record_failover(self, rep: Optional[Replica], res: Result) -> None:
+        """Failover recovery time: fence -> the first migrated request's
+        first token on its new replica. The first-token wall comes from
+        the collecting replica's tracer spans (``submitted_at`` keeps the
+        FLEET intake stamp across migration, so it cannot be used);
+        fallback is delivery time — an upper bound, chunk-granular like
+        every TTFT here."""
+        if not self._failover_pending or self._fence_t is None:
+            return
+        self._failover_pending = False
+        recovery = None
+        if rep is not None:
+            for row, evs in rep.sched.tracer.finished:
+                if row.request_id == res.id:
+                    stamps = [e.t for e in evs if e.event == "first_token"]
+                    if stamps:
+                        recovery = stamps[-1] - self._fence_t
+        if recovery is None:
+            recovery = time.monotonic() - self._fence_t
+        recovery = max(recovery, 0.0)
+        self.last_failover_s = recovery
+        reg = get_registry()
+        reg.gauge("fleet_failover_recovery_s", component="fleet",
+                  **self._fleet_labels).set(recovery)
+        reg.histogram("fleet_failover_recovery_dist_s", component="fleet",
+                      **self._fleet_labels).observe(recovery)
+        emit_event("fleet_failover_recovered",
+                   replica=rep.name if rep is not None else None,
+                   request_id=res.id, recovery_s=round(recovery, 4))
+
+    # -- fence / migrate / rejoin -------------------------------------------
+
+    def _fence(self, rep: Replica, reason: str) -> None:
+        if rep.fenced:
+            return
+        now = time.monotonic()
+        rep.fenced = True
+        rep.fenced_at = now
+        rep.fence_reason = reason
+        rep.fences += 1
+        if not self._failover_pending:
+            # The failover clock measures the OLDEST unrecovered fence: a
+            # second fence landing before the first fence's migrated work
+            # produced a token must not restart the clock (it would
+            # under-report fleet_failover_recovery_s).
+            self._fence_t = now
+        reg = get_registry()
+        reg.counter("fleet_fenced_total", component="fleet",
+                    replica=rep.name, reason=reason).inc()
+        self._update_health_gauge()
+        emit_event("replica_fenced", replica=rep.name, reason=reason,
+                   live=rep.sched.pool.occupancy,
+                   queued=len(rep.sched.queue))
+        logger.warning(
+            "fencing replica %s (%s): %d live, %d queued — draining and "
+            "migrating", rep.name, reason, rep.sched.pool.occupancy,
+            len(rep.sched.queue),
+        )
+        # Drain through the journal path with ZERO grace: a replica judged
+        # sick must not keep decoding work that should migrate — and for a
+        # crash there is no replica left to grant grace to.
+        rep.sched.request_drain(grace_s=0.0)
+        rep.sched.step(rep.stats)
+        if reason in CRASH_CLASS_REASONS and rep.sched.breakers is not None:
+            # The signal says the stages are DEAD, not merely flaky: force
+            # the breakers open so the rejoin canary must pass through
+            # their half-open machinery (fleet-level half-open mirrors the
+            # per-stage machine).
+            rep.sched.breakers.trip("prefill")
+            rep.sched.breakers.trip("decode")
+        migrated, newly_migrated = 0, 0
+        for rid in list(rep.assigned):
+            req = rep.assigned.pop(rid)
+            res = rep.sched.take_result(rid)
+            if res is not None and res.finish_reason != "preempted":
+                # Terminal before the fence took hold — deliver as-is.
+                self._deliver(rid, res, rep=rep)
+                continue
+            # Unfinished on the fenced replica: migrate with the ORIGINAL
+            # id/settings/row_seed (greedy parity for survivors) and a
+            # fresh retry budget (per-replica fault domain — its requeue
+            # was spent on a replica now out of the fleet).
+            req.retries = 0
+            self._migrating.append(req)
+            migrated += 1
+            if rid not in self._migrated_ids:
+                # Unique-request counting: a request re-migrated by a
+                # SECOND fence must not inflate the migrated side of the
+                # migrated==recovered invariant.
+                self._migrated_ids.add(rid)
+                newly_migrated += 1
+        if newly_migrated:
+            reg.counter("fleet_migrated_requests_total", component="fleet",
+                        **self._fleet_labels).inc(newly_migrated)
+        if migrated:
+            self._failover_pending = True
+        emit_event("replica_fence_complete", replica=rep.name,
+                   reason=reason, migrated=migrated)
+
+    def _maybe_rejoin(self, rep: Replica) -> bool:
+        """Probe a fenced replica once its cooldown elapses; rejoin on a
+        passed probe, restart the cooldown on a failed one. Returns True
+        when a probe actually ran (the tick progressed)."""
+        now = time.monotonic()
+        if rep.fenced_at is None or \
+                now - rep.fenced_at < self.fleet.fence_cooldown_s:
+            return False
+        board = rep.sched.breakers
+        if board is not None and any(
+            (board.seconds_until_probe(stage) or 0) > 0
+            for stage in board.breakers
+        ):
+            # An OPEN breaker still inside its cooldown cannot half-open:
+            # the probe's serve() would sleep-spin the single-threaded
+            # fleet loop until it can (freezing every HEALTHY replica for
+            # the remainder of the breaker cooldown — e.g. the default
+            # fence_cooldown_s 1.0 < breaker_cooldown_s 5.0). Defer the
+            # probe until the breakers are probeable; the fleet keeps
+            # serving meanwhile.
+            return False
+        if not self._rejoin_probe(rep):
+            rep.fenced_at = now  # re-fence: cooldown restarts
+            get_registry().counter(
+                "fleet_rejoin_denied_total", component="fleet",
+                replica=rep.name,
+            ).inc()
+            emit_event("replica_rejoin_denied", replica=rep.name)
+            logger.warning("replica %s failed its rejoin probe; staying "
+                           "fenced", rep.name)
+            return True
+        rep.fenced = False
+        rep.fenced_at = None
+        rep.fence_reason = None
+        rep.rejoins += 1
+        get_registry().counter("fleet_rejoins_total", component="fleet",
+                               replica=rep.name).inc()
+        self._update_health_gauge()
+        emit_event("replica_rejoined", replica=rep.name)
+        logger.warning("replica %s passed its rejoin probe; back in the "
+                       "fleet", rep.name)
+        return True
+
+    def _greedy_settings(self) -> bool:
+        s = self.settings
+        return s.temperature == 0.0 and s.top_k == 0 and s.top_p == 1.0
+
+    def _rejoin_probe(self, rep: Replica) -> bool:
+        """The canary warm-up gate. Greedy fleets decode the golden prompt
+        through the fenced replica's own scheduler and compare
+        token-for-token against ONE static-engine reference recorded on
+        first use (``CanaryProbe``); sampled fleets — where no
+        deterministic reference exists — gate on a smoke decode completing
+        cleanly. Either way the probe's decode IS the replica breakers'
+        half-open probe, so a pass closes them and walks the ladder back
+        to 0 before traffic returns. The journal is detached for the
+        probe's duration: probes are synthetic traffic a successor process
+        must never resume."""
+        saved_journal, rep.sched.journal = rep.sched.journal, None
+        try:
+            if self._greedy_settings():
+                return self._replica_canary(rep).probe(rep.sched)
+            self._probe_seq += 1
+            smoke = Request(
+                prompt="warm-up probe: list three colors.",
+                id=f"__fleet_probe_{rep.name}_{self._probe_seq}__",
+                settings=dataclasses.replace(self.settings, max_tokens=min(
+                    self.settings.max_tokens, self.integrity.canary_max_tokens
+                )),
+                row_seed=0,
+            )
+            res = rep.sched.serve([smoke])[0]
+            get_registry().counter(
+                "canary_runs_total", component="serving", replica=rep.name
+            ).inc()
+            return bool(res.ok)
+        finally:
+            rep.sched.journal = saved_journal
+
+    def _replica_canary(self, rep: Replica):
+        """The replica's probe, built lazily from ONE shared static-engine
+        reference — used by both the rejoin gate and the backend's
+        periodic canary (same object, same board)."""
+        if rep.canary is None:
+            from fairness_llm_tpu.integrity.canary import CanaryProbe
+
+            if self._canary_ref is None:
+                self._canary_ref = CanaryProbe.record(
+                    rep.engine, max_tokens=self.integrity.canary_max_tokens,
+                )
+            rep.canary = self._canary_ref.for_replica(
+                rep.name, board=rep.sched.breakers
+            )
+        return rep.canary
+
+    def periodic_canary(self) -> bool:
+        """The backend's ``--canary-every`` path in fleet mode: probe ONE
+        unfenced replica (round-robin) with its own per-replica canary —
+        a mismatch trips THAT replica's decode breaker, so the
+        ladder/router/fence machinery contains it exactly like any other
+        replica fault (a fleet-level probe through the router couldn't
+        attribute a mismatch to a replica, and with no backend board it
+        would contain nothing). Greedy fleets only — sampled output has
+        no deterministic reference. Returns the probe result (True when
+        nothing was probeable)."""
+        if not self._greedy_settings():
+            return True
+        live = [r for r in self.replicas if not r.fenced]
+        if not live:
+            return True
+        rep = live[self._canary_rr % len(live)]
+        self._canary_rr += 1
+        probe = self._replica_canary(rep)
+        saved_journal, rep.sched.journal = rep.sched.journal, None
+        try:
+            return probe.probe(rep.sched)
+        finally:
+            rep.sched.journal = saved_journal
+
+    def _update_health_gauge(self) -> None:
+        get_registry().gauge(
+            "fleet_healthy_replicas", component="fleet", **self._fleet_labels
+        ).set(self.healthy_count)
+
+    # -- process-wide drain / stats ------------------------------------------
+
+    def _drain_all(self) -> None:
+        """A process-wide drain (SIGTERM via ``GracefulDrain``) drains
+        every replica with the CONFIGURED grace — this is preemption, not
+        sickness — and preempts the fleet-held tail; journal records stay
+        unfinished for ``resume-serving``."""
+        logger.warning("fleet drain: %d replica(s), %d fleet-held "
+                       "request(s)",
+                       len(self.replicas),
+                       len(self._pending) + len(self.queue)
+                       + len(self._migrating))
+        for rep in self.replicas:
+            if rep.sched.has_work:
+                rep.sched.step(rep.stats)  # step() honors the drain flag
+            for rid in list(rep.assigned):
+                res = rep.sched.take_result(rid)
+                if res is not None:
+                    del rep.assigned[rid]
+                    self._deliver(rid, res, rep=rep)
+        hint = (f"resume with: resume-serving {self.journal.journal_dir}"
+                if self.journal is not None
+                else "no serving journal configured; request is lost at exit")
+        held = list(self._pending) + self.queue.pop(len(self.queue)) \
+            + list(self._migrating)
+        self._pending.clear()
+        self._migrating.clear()
+        for req in held:
+            self._deliver(req.id, Result(
+                id=req.id, ok=False, finish_reason="preempted",
+                error=f"drained before routing ({hint})",
+                retries=req.retries,
+                latency_s=time.monotonic() - req.submitted_at,
+            ))
+        get_registry().counter("serving_preempted_total", component="fleet",
+                               **self._fleet_labels).inc(len(held))
+
+    def _finish_stats(self) -> None:
+        agg = ServingStats(num_slots=0)
+        for rep in self.replicas:
+            rep.sched.finish_stats(rep.stats)
+            agg = agg.merge(rep.stats)
+            rep.stats = ServingStats(num_slots=rep.sched.num_slots)
+        agg.num_slots = self.num_slots
+        agg.rejected += self.queue.rejected - self._rejected_taken
+        self._rejected_taken = self.queue.rejected
+        self.last_stats = agg

@@ -48,14 +48,21 @@ class AdmissionQueue:
     def full(self) -> bool:
         return len(self._q) >= self.capacity
 
-    def submit(self, request: Request, count_rejection: bool = True) -> bool:
+    def submit(self, request: Request, count_rejection: bool = True,
+               front: bool = False) -> bool:
         """Admit ``request`` to the back of the queue; False = backpressure
         (queue full or rate quota exhausted), nothing enqueued.
 
         ``count_rejection=False`` is for internal retries of an
         already-accepted request (the scheduler's pending-overflow top-up):
         the attempt still respects capacity and quota, but a refusal is not
-        a new rejection for the stats."""
+        a new rejection for the stats.
+
+        ``front=True`` admits at the HEAD — the fleet's migration path
+        (``serving/fleet.py``): a request drained off a fenced replica
+        already waited through a queue once, so on its new replica it goes
+        ahead of work that hasn't (the ``requeue`` rationale, but still
+        subject to capacity/quota because this queue never saw it)."""
         if self.closed:
             if count_rejection:
                 self.rejected += 1
@@ -68,7 +75,10 @@ class AdmissionQueue:
             if count_rejection:
                 self.rejected += 1
             return False
-        self._q.append(request)
+        if front:
+            self._q.appendleft(request)
+        else:
+            self._q.append(request)
         return True
 
     def close(self) -> None:

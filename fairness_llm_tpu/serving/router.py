@@ -1,0 +1,175 @@
+"""Health-aware replica router: scoring, placement, and fence policy.
+
+Round-robin is the right router exactly until one replica gets sick — then
+it keeps feeding the sick replica 1/N of all traffic, each request burning
+its requeue budget on a stage that was never going to serve it. This router
+instead scores every replica from the health state the serving/resilience
+layers already export and places each admission on the healthiest,
+least-loaded replica:
+
+- **breaker states** (the replica's own ``BreakerBoard``): an OPEN stage is
+  refusing work outright, a HALF_OPEN one is probing — both discount the
+  score multiplicatively, so a replica mid-recovery takes a trickle while a
+  healthy sibling takes the bulk;
+- **degradation level**: each rung the replica's ladder has climbed is a
+  feature it already shed — discounted accordingly;
+- **canary freshness** (``canary_last_ok`` gauge): a replica whose last
+  canary MISMATCHED is producing wrong-but-finite output — discounted
+  hardest of all, since its breakers may look healthy;
+- **load**: live slots + queued depth relative to capacity, plus the
+  ``queue_depth_hwm`` high-water gauge the scheduler now maintains (an
+  instantaneous depth of 0 right after a burst says "idle"; the high-water
+  mark says "this replica was just drowning") — the classic
+  power-of-weighted-choices denominator.
+
+The router is also where the FENCE policy lives (``should_fence``): a
+replica whose ladder climbed past ``FleetConfig.fence_ladder_level``, whose
+open-breaker count reached ``fence_open_breakers``, or whose external stall
+probe fired (``StepWatchdog.stalled`` reading the per-replica liveness
+gauge) is handed to the ``ReplicaSet`` to fence — containment itself
+(drain, migrate, canary-gated rejoin) is the fleet's job, not the
+router's.
+
+Deterministic by design: scores derive from replica state only, ties break
+on replica name — the same fleet state always routes the same way, which is
+what makes fleet drills reproducible on the CPU harness.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+from fairness_llm_tpu.config import FleetConfig
+from fairness_llm_tpu.resilience.breaker import HALF_OPEN, OPEN
+from fairness_llm_tpu.telemetry import get_registry
+
+logger = logging.getLogger(__name__)
+
+# Multiplicative score discounts. An OPEN breaker does not zero the score:
+# a replica with only its decode breaker open can still ACCEPT work that
+# decodes once the half-open probe closes it — starving it entirely would
+# just shift the backlog to its siblings and then thundering-herd it on
+# recovery.
+OPEN_BREAKER_DISCOUNT = 0.10
+HALF_OPEN_BREAKER_DISCOUNT = 0.50
+DEGRADATION_RUNG_DISCOUNT = 0.25  # per ladder level
+CANARY_MISMATCH_DISCOUNT = 0.25
+
+
+class HealthRouter:
+    """Scores ``Replica`` objects (``serving/fleet.py``) and picks a target
+    for one admission. Stateless between calls except for the config — all
+    health inputs are read fresh from the replica each time."""
+
+    def __init__(self, fleet: Optional[FleetConfig] = None):
+        self.fleet = fleet or FleetConfig()
+
+    # -- scoring -------------------------------------------------------------
+
+    def health_score(self, replica) -> float:
+        """Health in [0, 1]: 1.0 = nothing wrong, 0.0 = fenced. Load is NOT
+        part of this number (``placement_weight`` folds it in) — health is
+        what the fence policy and the ``replica_health_score`` gauge
+        report, and a busy-but-healthy replica must read 1.0."""
+        if replica.fenced:
+            score = 0.0
+        else:
+            score = 1.0
+            board = replica.sched.breakers
+            if board is not None:
+                for breaker in board.breakers.values():
+                    if breaker.state == OPEN:
+                        score *= OPEN_BREAKER_DISCOUNT
+                    elif breaker.state == HALF_OPEN:
+                        score *= HALF_OPEN_BREAKER_DISCOUNT
+                score *= max(
+                    0.0, 1.0 - DEGRADATION_RUNG_DISCOUNT * board.ladder.level
+                )
+            # canary_last_ok: 1 ok / 0 mismatch / -1 never probed (neutral).
+            last_ok = get_registry().read_value(
+                "canary_last_ok", default=-1.0, component="serving",
+                replica=replica.name,
+            )
+            if last_ok == 0.0:
+                score *= CANARY_MISMATCH_DISCOUNT
+        get_registry().gauge(
+            "replica_health_score", component="fleet", replica=replica.name
+        ).set(score)
+        return score
+
+    def load(self, replica) -> float:
+        """Outstanding work relative to slot capacity, blended with the
+        queue-depth high-water mark (see module docstring): live slots +
+        queued requests now, plus a fraction of the recent worst-case
+        queue depth, normalized by the pool size."""
+        sched = replica.sched
+        outstanding = sched.pool.occupancy + len(sched.queue) \
+            + len(sched._pending)
+        hwm = get_registry().read_value(
+            "queue_depth_hwm", default=0.0, component="serving",
+            replica=replica.name,
+        )
+        return (outstanding + 0.25 * hwm) / max(sched.num_slots, 1)
+
+    def placement_weight(self, replica) -> float:
+        """What ``pick`` maximizes: health discounted by load. A replica at
+        2x its slot capacity with full health weighs like an idle one at
+        1/3 health — sick beats drowning, idle beats both."""
+        return self.health_score(replica) / (1.0 + self.load(replica))
+
+    def pick(self, replicas: Sequence) -> Optional[object]:
+        """The target for ONE admission: the routable replica (not fenced,
+        queue open and not full, nonzero health) with the highest
+        placement weight; ties break on name. None when nothing is
+        routable — the caller holds the request (bounded fleet queue =
+        backpressure, never loss)."""
+        best, best_weight = None, 0.0
+        for rep in replicas:
+            if rep.fenced or rep.sched.queue.closed or rep.sched.queue.full:
+                continue
+            weight = self.placement_weight(rep)
+            if weight <= 0.0:
+                continue
+            if best is None or weight > best_weight or (
+                weight == best_weight and rep.name < best.name
+            ):
+                best, best_weight = rep, weight
+        return best
+
+    # -- fence policy --------------------------------------------------------
+
+    def should_fence(self, replica) -> Optional[str]:
+        """Reason this replica should be fenced right now, or None. The
+        injected replica_crash/replica_hang path does not come through
+        here — the fleet fences those directly (the 'signal' arrived, no
+        inference needed); this is the INFERRED path, from the same
+        breaker/ladder transitions and the stall probe that already drive
+        single-engine degradation."""
+        if replica.fenced:
+            return None
+        board = replica.sched.breakers
+        cfg = self.fleet
+        if board is not None:
+            if 0 < cfg.fence_ladder_level <= board.ladder.level:
+                return "degraded"
+            if 0 < cfg.fence_open_breakers <= board.open_count():
+                return "breakers"
+        watchdog = replica.sched.watchdog
+        if watchdog is not None and replica.sched.has_work \
+                and watchdog.stalled() is not None:
+            # has_work gates the probe: an IDLE replica legitimately
+            # completes no steps, so its liveness gauge going stale is not
+            # a stall — without the gate, every replica would fence on the
+            # first tick after any idle gap longer than max_step_seconds.
+            return "stalled"
+        return None
+
+
+def round_robin_pick(replicas: List, counter: int) -> Optional[object]:
+    """The baseline this module replaces, kept for A/B comparisons in
+    tests/benches: the counter-th unfenced replica, health-blind."""
+    live = [r for r in replicas if not r.fenced]
+    if not live:
+        return None
+    return live[counter % len(live)]

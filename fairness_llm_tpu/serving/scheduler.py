@@ -58,8 +58,11 @@ containable ``NumericsFault`` (requeue-once, breaker-visible, counted in
 logits host-side so the guard is drillable on the CPU harness.
 
 Sharded meshes are not supported yet (the slot scatter would need dp-aware
-placement); serving targets the single-chip engine — multi-replica routing
-is the next layer up, not this one.
+placement); serving targets the single-chip engine. Multi-replica routing
+IS the next layer up — ``serving/fleet.py`` drives N of these schedulers
+(one per replica, each with its own slot pool, breakers, and watchdog)
+through the public ``step()`` hook, with per-replica ``{"replica": name}``
+labels on every instrument this loop writes.
 """
 
 from __future__ import annotations
@@ -132,6 +135,7 @@ class ContinuousScheduler:
         resilience: Optional[ResilienceConfig] = None,
         journal: Optional[ServingJournal] = None,
         breakers: Optional[BreakerBoard] = None,
+        replica: Optional[str] = None,
     ):
         if engine.mesh is not None:
             raise ValueError(
@@ -142,6 +146,13 @@ class ContinuousScheduler:
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.settings = settings or ModelSettings()
+        # Replica identity (serving/fleet.py): every instrument this
+        # scheduler writes — tracer histograms, breaker/watchdog state,
+        # fault counters — carries a {"replica": name} label so N replicas'
+        # health reads apart in one registry. None (the single-engine path)
+        # adds no label: metric keys are byte-identical to before.
+        self.replica = replica
+        self.labels = {"replica": replica} if replica else {}
         self.sampler = SamplerSettings(
             temperature=self.settings.temperature,
             top_k=self.settings.top_k,
@@ -204,8 +215,11 @@ class ContinuousScheduler:
         # submitted -> admitted -> prefill_start -> first_token -> terminal
         # timeline, feeding the queue-wait/TTFT/per-token/e2e histograms in
         # the process registry. Always on — host-side timestamps only.
-        self.tracer = RequestTracer(component="serving")
-        self._heartbeat = Heartbeat(interval_s=30.0, name="serving")
+        self.tracer = RequestTracer(component="serving", labels=self.labels)
+        self._heartbeat = Heartbeat(
+            interval_s=30.0,
+            name=f"serving[{replica}]" if replica else "serving",
+        )
         # Resilience (resilience/): watchdog + breakers arm only when the
         # config enables them (or a shared BreakerBoard is handed in, the
         # ServingBackend case); the journal ledgers intake when present. In
@@ -219,15 +233,21 @@ class ContinuousScheduler:
             self.breakers = BreakerBoard(
                 failure_threshold=r.breaker_threshold,
                 cooldown_s=r.breaker_cooldown_s,
+                labels=self.labels,
             )
         else:
             self.breakers = None
         self.watchdog: Optional[StepWatchdog] = (
-            StepWatchdog(r.max_step_seconds)
+            StepWatchdog(r.max_step_seconds, labels=self.labels)
             if r.enabled and r.max_step_seconds > 0 else None
         )
         self.journal = journal
         self._drain_flag = False
+        # Per-drain grace override (request_drain(grace_s=...)): the fleet
+        # fences with grace 0 — a sick replica must not keep decoding work
+        # that should migrate — while signal-driven drains keep the
+        # configured grace.
+        self._drain_grace_override: Optional[float] = None
         # Degradation-ladder state: rung 2 halves the decode chunk and
         # soft-caps concurrent slots; both restore when the ladder retreats.
         self._base_decode_chunk = self.decode_chunk
@@ -432,13 +452,23 @@ class ContinuousScheduler:
                 "compiled for those settings"
             )
 
-    def submit(self, request: Request) -> bool:
+    def submit(self, request: Request, front: bool = False,
+               restamp: bool = True) -> bool:
         """Queue one request; False = backpressure (queue full / rate
         quota). The deadline/latency clock (re)starts here — a Request
-        object built ahead of time doesn't age before the server sees it."""
+        object built ahead of time doesn't age before the server sees it.
+        ``front=True`` admits at the head of the line (the fleet's
+        migration path — see ``AdmissionQueue.submit``). ``restamp=False``
+        keeps the EXISTING ``submitted_at``: the fleet stamped the request
+        at its own intake, and re-stamping on routing (or on migration off
+        a fenced replica) would silently extend the deadline and hide the
+        fleet-queue wait from the latency — the same
+        deadline-from-first-submission contract ``resume-serving``
+        preserves by shrinking resumed deadlines."""
         self._check_settings(request)
-        request.submitted_at = time.monotonic()
-        accepted = self.queue.submit(request)
+        if restamp:
+            request.submitted_at = time.monotonic()
+        accepted = self.queue.submit(request, front=front)
         if accepted:
             # Rejections are NOT recorded here: queue.rejected already counts
             # them and the next drain publishes the delta as
@@ -501,45 +531,81 @@ class ContinuousScheduler:
 
     # -- internals ----------------------------------------------------------
 
-    def request_drain(self) -> None:
+    def request_drain(self, grace_s: Optional[float] = None) -> None:
         """Programmatic drain trigger (the signal path is ``GracefulDrain``):
         the loop stops admission at its next iteration, finishes what it can
-        within ``drain_grace_s``, and preempts the rest to the journal."""
+        within ``drain_grace_s``, and preempts the rest to the journal.
+        ``grace_s`` overrides the configured grace for THIS drain only —
+        the fleet fences sick replicas with 0 (their live work migrates
+        instead of finishing on a replica already judged unhealthy)."""
         self._drain_flag = True
+        self._drain_grace_override = grace_s
 
     def _drain_requested(self) -> bool:
         # Own flag OR the process-wide one a GracefulDrain handler sets —
         # so one SIGTERM drains every scheduler in the process.
         return self._drain_flag or drain_requested()
 
+    @property
+    def has_work(self) -> bool:
+        """Anything still owed a Result: pending overflow, queued, or live
+        in a slot. The fleet router polls this to decide which replicas to
+        step."""
+        return bool(self._pending or len(self.queue) or self.pool.occupancy)
+
+    def step(self, stats: ServingStats) -> bool:
+        """ONE admission+decode loop iteration — the interleaving hook the
+        fleet router (``serving/fleet.py``) drives so N replicas share the
+        host thread instead of one ``serve()`` monopolizing it. Honors a
+        pending drain request exactly as ``_run_loop`` does (executing it
+        counts as not-progressed: everything preempts, nothing decodes).
+        Returns True when any work moved."""
+        if self._drain_requested():
+            self._execute_drain(stats)
+            return False
+        self._apply_degradation()
+        progressed = self._iterate(stats)
+        self._feed(stats)
+        self._heartbeat.poke(
+            occupancy=self.pool.occupancy, queue_depth=len(self.queue),
+            completed=stats.completed, decoded_tokens=stats.decoded_tokens,
+        )
+        return progressed
+
+    def finish_stats(self, stats: ServingStats) -> None:
+        """Close out one drain's stats: attribute queue rejections not yet
+        reported by an earlier drain — including public submit() refusals
+        made BETWEEN drains (the single-threaded loop means none can occur
+        during one) — and publish once (the registry accumulates process
+        totals while this ServingStats object stays the per-drain
+        record)."""
+        stats.rejected = self.queue.rejected - self._rejected_taken
+        self._rejected_taken = self.queue.rejected
+        stats.publish(labels=self.labels)
+        # Reset the LIVE high-water mark to the (now drained) depth: the
+        # gauge is a per-drain-window worst case for online readers (the
+        # fleet router), not a lifetime one — without the reset, one
+        # historical burst would discount this scheduler's placement
+        # weight forever. The per-drain record keeps its own max in
+        # serving_queue_depth_max.
+        get_registry().gauge(
+            "queue_depth_hwm", component="serving", **self.labels
+        ).set(len(self.queue))
+
     def _run_loop(self, stats: ServingStats) -> None:
         self._feed(stats)
-        while self._pending or len(self.queue) or self.pool.occupancy:
-            if self._drain_requested():
-                self._execute_drain(stats)
-                break
-            self._apply_degradation()
-            progressed = self._iterate(stats)
-            self._feed(stats)
-            self._heartbeat.poke(
-                occupancy=self.pool.occupancy, queue_depth=len(self.queue),
-                completed=stats.completed, decoded_tokens=stats.decoded_tokens,
-            )
-            if not progressed:
+        while self.has_work:
+            drained = self._drain_requested()
+            if not self.step(stats) and not drained:
                 # Nothing moved this iteration — rate-limited admission with
                 # an empty pool, or an OPEN breaker refusing the stage while
                 # work waits. Yield briefly instead of spinning the loop dry
                 # (a fault-free loop with work always progresses, so this
-                # never fires on the hot path).
+                # never fires on the hot path). A just-executed drain is
+                # exempt: it preempted everything, so the loop exits on the
+                # next has_work check without sleeping.
                 time.sleep(0.002)
-        # Attribute queue rejections not yet reported by an earlier drain —
-        # including public submit() refusals made BETWEEN drains (the
-        # single-threaded loop means none can occur during one).
-        stats.rejected = self.queue.rejected - self._rejected_taken
-        self._rejected_taken = self.queue.rejected
-        # One publish per drain: the registry accumulates process totals
-        # while this ServingStats object stays the per-drain record.
-        stats.publish()
+        self.finish_stats(stats)
 
     def _apply_degradation(self) -> None:
         """Make the scheduler's knobs match the ladder's current rung.
@@ -597,8 +663,9 @@ class ContinuousScheduler:
             n_queued, n_pending, n_live,
         )
         emit_event("drain_started", queued=n_queued, pending=n_pending,
-                   live=n_live)
-        get_registry().counter("drains_total", component="serving").inc()
+                   live=n_live, **self.labels)
+        get_registry().counter("drains_total", component="serving",
+                               **self.labels).inc()
         self.queue.close()
         try:
             for req in self._pending:
@@ -607,7 +674,9 @@ class ContinuousScheduler:
             for req in self.queue.pop(len(self.queue)):
                 self._preempt(req, stats)
             completed_before = stats.completed
-            grace = self.resilience.drain_grace_s
+            grace = (self._drain_grace_override
+                     if self._drain_grace_override is not None
+                     else self.resilience.drain_grace_s)
             t0 = time.monotonic()
             while self.pool.occupancy and time.monotonic() - t0 < grace:
                 if not self._decode(stats):  # breaker may refuse the stage
@@ -631,8 +700,10 @@ class ContinuousScheduler:
             # flag (GracefulDrain) intentionally stays set: that process is
             # on its way out, and every later serve should drain too.
             self._drain_flag = False
+            self._drain_grace_override = None
         emit_event("drain_complete", preempted=stats.preempted,
-                   completed_during_drain=stats.completed - completed_before)
+                   completed_during_drain=stats.completed - completed_before,
+                   **self.labels)
 
     def _feed(self, stats: ServingStats) -> None:
         # Internal top-up from serve()'s pending overflow: a failed attempt
@@ -693,7 +764,7 @@ class ContinuousScheduler:
             # incident; the registry label can.
             get_registry().counter(
                 "serving_requeues_by_cause_total", component="serving",
-                cause=cause,
+                cause=cause, **self.labels,
             ).inc()
             self.tracer.record(request.id, "requeued")
             self.queue.requeue(request)
@@ -874,7 +945,7 @@ class ContinuousScheduler:
             logger.warning("prefill batch (%d, %d) failed: %s", nb, P, e)
             get_registry().counter(
                 "faults_total", component="serving",
-                kind=kind, stage="prefill",
+                kind=kind, stage="prefill", **self.labels,
             ).inc()
             if self.breakers is not None:
                 self.breakers.record_failure("prefill")
@@ -886,7 +957,7 @@ class ContinuousScheduler:
         if self.breakers is not None:
             self.breakers.record_success("prefill")
         get_registry().histogram(
-            "prefill_wall_s", component="serving"
+            "prefill_wall_s", component="serving", **self.labels
         ).observe(time.monotonic() - pf_t0)
         stats.prefill_batches += 1
         stats.prefill_tokens += int(tb.lengths.sum())
@@ -995,7 +1066,7 @@ class ContinuousScheduler:
             logger.warning("decode chunk failed: %s", e)
             get_registry().counter(
                 "faults_total", component="serving",
-                kind=kind, stage="decode",
+                kind=kind, stage="decode", **self.labels,
             ).inc()
             if self.breakers is not None:
                 self.breakers.record_failure("decode")
@@ -1051,6 +1122,15 @@ class ContinuousScheduler:
         depth = len(self.queue)
         stats.queue_depth_sum += depth
         stats.queue_depth_max = max(stats.queue_depth_max, depth)
+        # Live high-water mark, updated every loop iteration — the
+        # per-decode-step queue_depth gauge (tracer.sample_step_gauges) is
+        # instantaneous and the per-drain serving_queue_depth_max publishes
+        # only AFTER a drain, so neither shows a mid-drain spike to an
+        # online reader. The fleet router reads this (registry.read_value)
+        # as its backpressure signal when scoring replicas.
+        get_registry().gauge(
+            "queue_depth_hwm", component="serving", **self.labels
+        ).set_max(depth)
         now = time.monotonic()
         progressed = False
         for req in self.queue.drain_expired(now):

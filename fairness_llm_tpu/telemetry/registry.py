@@ -222,6 +222,17 @@ class MetricsRegistry:
         when no writer has touched that (name, labels) yet."""
         return self._metrics.get((name, _labels_key(labels)))
 
+    def read_value(self, name: str, default: float = 0.0,
+                   **labels: str) -> float:
+        """Peek an instrument's scalar value without creating it — the
+        read path for observers of metrics OTHER components own (the fleet
+        router reading a scheduler's ``queue_depth_hwm``, a supervisor
+        reading breaker states). Counters and gauges both expose
+        ``.value``; histograms have no single scalar and return
+        ``default``, as does an untouched (name, labels)."""
+        m = self.peek(name, **labels)
+        return getattr(m, "value", default) if m is not None else default
+
     # -- export surface -----------------------------------------------------
 
     def instruments(self) -> List[object]:

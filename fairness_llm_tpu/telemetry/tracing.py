@@ -90,9 +90,15 @@ class RequestTracer:
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 component: str = "serving", keep_finished: int = 256):
+                 component: str = "serving", keep_finished: int = 256,
+                 labels: Optional[Dict[str, str]] = None):
         self._registry = registry
         self.component = component
+        # Extra instrument labels (the fleet's per-replica schedulers pass
+        # {"replica": name} so two replicas' histograms never share an
+        # instrument); empty for the single-engine path — metric keys are
+        # byte-identical to before.
+        self.labels = dict(labels or {})
         self._events: Dict[str, List[SpanEvent]] = {}
         self.finished: Deque[Tuple[TraceSummaryRow, List[SpanEvent]]] = \
             collections.deque(maxlen=keep_finished)
@@ -111,7 +117,7 @@ class RequestTracer:
         from fairness_llm_tpu.telemetry import emit_event  # lazy: no cycle
 
         emit_event("span", request_id=request_id, event=event, t=ev.t,
-                   component=self.component)
+                   component=self.component, **self.labels)
         return ev
 
     def events(self, request_id: str) -> List[SpanEvent]:
@@ -143,25 +149,27 @@ class RequestTracer:
         row = TraceSummaryRow(request_id=request_id, outcome=outcome,
                               tokens=tokens)
         reg = self._reg()
-        c = self.component
+        c, lbl = self.component, self.labels
         if submitted is not None and admitted is not None:
             row.queue_wait_s = max(admitted - submitted, 0.0)
-            reg.histogram("queue_wait_s", component=c).observe(row.queue_wait_s)
+            reg.histogram("queue_wait_s", component=c,
+                          **lbl).observe(row.queue_wait_s)
         if submitted is not None and first_tok is not None:
             row.ttft_s = max(first_tok - submitted, 0.0)
-            reg.histogram("ttft_s", component=c).observe(row.ttft_s)
+            reg.histogram("ttft_s", component=c, **lbl).observe(row.ttft_s)
         if submitted is not None:
             row.e2e_s = max(end - submitted, 0.0)
-            reg.histogram("e2e_latency_s", component=c).observe(row.e2e_s)
+            reg.histogram("e2e_latency_s", component=c,
+                          **lbl).observe(row.e2e_s)
         if first_tok is not None and tokens >= 2:
             row.per_output_token_s = max(end - first_tok, 0.0) / (tokens - 1)
-            reg.histogram("per_output_token_s", component=c).observe(
+            reg.histogram("per_output_token_s", component=c, **lbl).observe(
                 row.per_output_token_s
             )
         reg.counter("requests_finished_total", component=c,
-                    outcome=outcome).inc()
+                    outcome=outcome, **lbl).inc()
         if tokens:
-            reg.counter("output_tokens_total", component=c).inc(tokens)
+            reg.counter("output_tokens_total", component=c, **lbl).inc(tokens)
         self.finished.append((row, evs))  # evs already ends with the terminal
         return row
 
@@ -171,13 +179,13 @@ class RequestTracer:
         histograms (1-2-5 buckets), weighted by the steps the chunk ran so a
         long chunk counts proportionally."""
         reg = self._reg()
-        c = self.component
-        reg.gauge("slot_occupancy", component=c).set(occupancy)
-        reg.gauge("queue_depth", component=c).set(queue_depth)
+        c, lbl = self.component, self.labels
+        reg.gauge("slot_occupancy", component=c, **lbl).set(occupancy)
+        reg.gauge("queue_depth", component=c, **lbl).set(queue_depth)
         occ_h = reg.histogram("slot_occupancy_dist", DEFAULT_COUNT_BOUNDS,
-                              component=c)
+                              component=c, **lbl)
         dep_h = reg.histogram("queue_depth_dist", DEFAULT_COUNT_BOUNDS,
-                              component=c)
+                              component=c, **lbl)
         for _ in range(max(decode_steps, 1)):
             occ_h.observe(occupancy)
             dep_h.observe(queue_depth)

@@ -80,6 +80,17 @@ class ScriptedFaultInjector:
     harness, with no device fault hardware required. ``flip_bit`` is the
     at-rest sibling: one flipped bit in an artifact file, for manifest
     drills.
+
+    ``replica_crashes`` / ``replica_hangs`` script REPLICA-level faults for
+    the fleet router (``serving/fleet.py``): keys are replica names, values
+    the number of health polls the replica survives before the fault fires
+    ONCE (0 = on the first poll; a few polls lets the replica serve some
+    chunks first, so the drill exercises mid-flight migration, not just
+    cold routing). A "crash" stands in for the replica process dying
+    outright; a "hang" for the silent stall the watchdog's external probe
+    exists to catch. Both are counted with their own ``kind`` labels
+    (``injected_replica_crash`` / ``injected_replica_hang``) so a fleet
+    drill's telemetry reads apart from single-engine chaos.
     """
 
     def __init__(
@@ -89,6 +100,8 @@ class ScriptedFaultInjector:
         hang_seconds: float = 3600.0,
         corruptions: Optional[Dict[object, int]] = None,
         corruption_mode: str = "nan",
+        replica_crashes: Optional[Dict[str, int]] = None,
+        replica_hangs: Optional[Dict[str, int]] = None,
     ):
         if corruption_mode not in ("nan", "inf"):
             raise ValueError(
@@ -97,11 +110,21 @@ class ScriptedFaultInjector:
         self._budget = dict(faults or {})
         self._hang_budget = dict(hangs or {})
         self._corruption_budget = dict(corruptions or {})
+        self._replica_delay: Dict[str, tuple] = {}
+        for name, delay in (replica_crashes or {}).items():
+            self._replica_delay[name] = (int(delay), "replica_crash")
+        for name, delay in (replica_hangs or {}).items():
+            if name in self._replica_delay:
+                raise ValueError(
+                    f"replica {name!r} scripted for both crash and hang"
+                )
+            self._replica_delay[name] = (int(delay), "replica_hang")
         self.corruption_mode = corruption_mode
         self.hang_seconds = float(hang_seconds)
         self.fired: List[tuple] = []  # (request_id, stage) audit log
         self.hangs_fired: List[tuple] = []
         self.corruptions_fired: List[tuple] = []
+        self.replica_faults_fired: List[tuple] = []  # (replica, kind)
 
     def maybe_fail(self, request_id: str, stage: str) -> None:
         for key in ((request_id, stage), request_id):
@@ -152,6 +175,27 @@ class ScriptedFaultInjector:
                 ).inc()
                 return self.corruption_mode
         return None
+
+    def maybe_replica_fault(self, replica: str) -> Optional[str]:
+        """Replica-level fault due this health poll — ``"replica_crash"``,
+        ``"replica_hang"``, or None (almost always). The scripted delay
+        counts down one per poll; at zero the fault fires once and the
+        script entry is consumed (a crashed replica doesn't crash twice —
+        it fences, migrates its work, and rejoins through the canary)."""
+        entry = self._replica_delay.get(replica)
+        if entry is None:
+            return None
+        delay, kind = entry
+        if delay > 0:
+            self._replica_delay[replica] = (delay - 1, kind)
+            return None
+        del self._replica_delay[replica]
+        self.replica_faults_fired.append((replica, kind))
+        get_registry().counter(
+            "faults_total", component="fleet", kind=f"injected_{kind}",
+            stage="replica", replica=replica,
+        ).inc()
+        return kind
 
     @staticmethod
     def flip_bit(path: str, bit_index: int) -> None:

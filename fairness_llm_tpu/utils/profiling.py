@@ -338,27 +338,30 @@ class ServingStats:
         out["avg_queue_depth"] = round(self.avg_queue_depth, 3)
         return out
 
-    def publish(self, registry=None, component: str = "serving") -> None:
+    def publish(self, registry=None, component: str = "serving",
+                labels=None) -> None:
         """Mirror one drain's counters into the telemetry registry (same
         contract as ``SpeculationStats.publish``: call once per drain so the
         registry carries process totals). ``num_slots`` and
         ``queue_depth_max`` are level/high-water quantities, not event
-        counts, so they publish as gauges."""
+        counts, so they publish as gauges. ``labels`` adds extra instrument
+        labels (the fleet's per-replica schedulers pass
+        ``{"replica": name}``)."""
         from fairness_llm_tpu.telemetry import get_registry
 
         reg = registry if registry is not None else get_registry()
+        lbl = dict(labels or {})
         for name in (
             "admitted", "completed", "failed", "expired", "preempted",
             "rejected", "requeued", "prefill_batches", "prefill_tokens",
             "decode_steps", "decoded_tokens", "loop_iterations",
         ):
-            reg.counter(f"serving_{name}_total", component=component).inc(
-                getattr(self, name)
-            )
-        reg.gauge("serving_num_slots", component=component).set(self.num_slots)
-        reg.gauge("serving_queue_depth_max", component=component).set_max(
-            self.queue_depth_max
-        )
+            reg.counter(f"serving_{name}_total", component=component,
+                        **lbl).inc(getattr(self, name))
+        reg.gauge("serving_num_slots", component=component,
+                  **lbl).set(self.num_slots)
+        reg.gauge("serving_queue_depth_max", component=component,
+                  **lbl).set_max(self.queue_depth_max)
 
 
 @contextlib.contextmanager

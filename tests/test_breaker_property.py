@@ -1,0 +1,128 @@
+"""Property-based BreakerBoard invariants (hypothesis over random
+fault/success/clock sequences).
+
+The fleet (serving/fleet.py) multiplies the breaker machinery by N — every
+replica carries its own board, and the router's fence policy reads board
+state directly — so the state machine's invariants are now load-bearing N
+times over:
+
+1. **Transition order**: a breaker only ever moves along the legal edges
+   closed->open, open->half_open, half_open->closed, half_open->open.
+   There is no closed->half_open shortcut and no open->closed shortcut —
+   an open stage must always pass through a half-open probe to recover.
+2. **Ladder accounting**: the degradation level always equals the number
+   of stages currently NOT closed (each tripped stage holds exactly one
+   rung), and in particular all-breakers-healthy <=> level 0 — degradation
+   is a function of current health, never of trip history.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests skip where hypothesis isn't baked in
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from fairness_llm_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    STAGES,
+    BreakerBoard,
+)
+from fairness_llm_tpu.telemetry import use_registry
+
+LEGAL_EDGES = {
+    (CLOSED, OPEN),
+    (OPEN, HALF_OPEN),
+    (HALF_OPEN, CLOSED),
+    (HALF_OPEN, OPEN),
+}
+
+# One operation: (stage index, action). "tick" advances the fake clock past
+# the cooldown so the next allow() can half-open; "allow" is the consult
+# the serving loop makes before every stage attempt (and the only legal way
+# to reach half_open).
+OPS = st.lists(
+    st.tuples(
+        st.integers(min_value=0, max_value=len(STAGES) - 1),
+        st.sampled_from(["fail", "success", "allow", "tick"]),
+    ),
+    max_size=200,
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(ops=OPS, threshold=st.integers(min_value=1, max_value=4))
+def test_breaker_transition_order_and_ladder_invariant(ops, threshold):
+    clock = {"t": 0.0}
+    transitions = []
+    with use_registry():
+        board = BreakerBoard(
+            failure_threshold=threshold, cooldown_s=10.0,
+            clock=lambda: clock["t"],
+        )
+        for stage, breaker in board.breakers.items():
+            orig = breaker.on_transition
+
+            def spy(s, old, new, _orig=orig):
+                transitions.append((s, old, new))
+                _orig(s, old, new)
+
+            breaker.on_transition = spy
+        for idx, action in ops:
+            stage = STAGES[idx]
+            if action == "fail":
+                board.record_failure(stage)
+            elif action == "success":
+                board.record_success(stage)
+            elif action == "allow":
+                board.allow(stage)
+            else:  # tick: the cooldown elapses
+                clock["t"] += 11.0
+            # Ladder accounting after EVERY op: level == tripped stages.
+            tripped = sum(
+                1 for b in board.breakers.values() if b.state != CLOSED
+            )
+            assert board.ladder.level == tripped, (
+                f"level {board.ladder.level} != {tripped} tripped after "
+                f"{(stage, action)}"
+            )
+            assert (board.ladder.level == 0) == all(
+                b.state == CLOSED for b in board.breakers.values()
+            )
+        for s, old, new in transitions:
+            assert (old, new) in LEGAL_EDGES, (
+                f"illegal transition {old} -> {new} on stage {s}"
+            )
+
+
+@settings(max_examples=50, deadline=None)
+@given(ops=OPS)
+def test_open_breaker_refuses_until_cooldown(ops):
+    """allow() semantics under random driving: an OPEN breaker refuses
+    before its cooldown and half-opens (allowing) after — never the other
+    way around."""
+    clock = {"t": 0.0}
+    with use_registry():
+        board = BreakerBoard(failure_threshold=1, cooldown_s=10.0,
+                             clock=lambda: clock["t"])
+        for idx, action in ops:
+            stage = STAGES[idx]
+            breaker = board.breakers[stage]
+            if action == "fail":
+                board.record_failure(stage)
+            elif action == "success":
+                board.record_success(stage)
+            elif action == "tick":
+                clock["t"] += 11.0
+            else:
+                before = breaker.state
+                remaining = breaker.seconds_until_probe
+                allowed = board.allow(stage)
+                if before == OPEN and remaining is not None and remaining > 0:
+                    assert not allowed
+                    assert breaker.state == OPEN
+                elif before == OPEN:
+                    assert allowed and breaker.state == HALF_OPEN
+                else:
+                    assert allowed

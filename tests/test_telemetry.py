@@ -459,6 +459,50 @@ def test_scheduler_fault_cause_breakdown(engine):
         assert row.outcome == "completed"
 
 
+def test_queue_depth_high_water_mark_gauge(engine):
+    """The live admission-queue high-water mark (ISSUE-6 satellite): the
+    scheduler set_max's ``queue_depth_hwm`` every loop iteration — the
+    fleet router's online backpressure signal while the drain is in
+    flight — then resets it at drain close-out (a per-window worst case,
+    not a lifetime one; the lifetime max stays in
+    ``serving_queue_depth_max``). ``read_value`` peeks without
+    materializing instruments for replicas that never served."""
+    from fairness_llm_tpu.config import ServingConfig
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+    from fairness_llm_tpu.utils.profiling import ServingStats
+
+    with use_registry() as reg:
+        # 1 slot + 6 requests: the queue must back up to >= 5 deep.
+        sched = ContinuousScheduler(
+            engine,
+            ServingConfig(enabled=True, num_slots=1, max_prompt_len=64,
+                          max_new_tokens=8, decode_chunk=2,
+                          queue_capacity=16),
+            settings=_greedy(4),
+        )
+        reqs = [Request(prompt=f"count to {i}", id=f"hwm{i}",
+                        settings=_greedy(4)) for i in range(6)]
+        for r in reqs:
+            assert sched.submit(r)
+        stats = ServingStats(num_slots=1)
+        sched.step(stats)  # one loop iteration: the online-reader moment
+        assert reg.read_value("queue_depth_hwm", component="serving") >= 5
+        stats = sched.drain()
+        assert stats.completed == 6
+        for r in reqs:
+            assert sched.take_result(r.id).ok
+        # Drain close-out resets the live window; the per-drain record
+        # keeps the max.
+        assert reg.read_value("queue_depth_hwm", component="serving") == 0
+        assert reg.gauge("serving_queue_depth_max",
+                         component="serving").value >= 5
+        # read_value never creates: an unserved replica label stays absent.
+        assert reg.read_value("queue_depth_hwm", default=-1.0,
+                              component="serving", replica="ghost") == -1.0
+        assert reg.peek("queue_depth_hwm", component="serving",
+                        replica="ghost") is None
+
+
 def test_engine_generate_instrumented(engine):
     with use_registry() as reg:
         out = engine.generate(["one two three"], _greedy(4), seed=0)

@@ -22,11 +22,19 @@ What it does, in one process, deterministically:
    matches its static-engine reference, then a tampered reference
    (standing in for silently-corrupt serving output) trips the decode
    breaker and the degradation ladder;
-6. validates the ISSUE-4/5 acceptance: every request terminal (zero lost),
-   survivors token-for-token equal to the baseline (zero corrupt records —
-   the NaN chunk was retried, not delivered), the breaker cycle + hang +
-   numerics fault + manifest failure + canary mismatch all present in the
-   telemetry snapshot, and the journal empty.
+6. drills the REPLICA FLEET (ISSUE 6): serves the same workload through a
+   2-replica ``ReplicaSet`` and kills replica r1 mid-sweep (scripted
+   ``replica_crash``) — asserting zero lost requests, migrated survivors
+   token-identical to the single-engine greedy baseline, the healthy
+   replica serving throughout, and the killed replica rejoining through
+   its canary warm-up probe (``fleet_healthy_replicas`` back to 2);
+7. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
+   lost), survivors token-for-token equal to the baseline (zero corrupt
+   records — the NaN chunk was retried, not delivered), the breaker cycle
+   + hang + numerics fault + manifest failure + canary mismatch + fleet
+   fence/migrate/rejoin all present in the telemetry snapshot
+   (``validate_telemetry --require-fleet`` gates the fleet half), and the
+   journal empty.
 
 Usage (CI runs exactly this):
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --telemetry-dir chaos-tel
@@ -227,10 +235,73 @@ def main() -> int:
           and board.ladder.level >= 1,
           "canary mismatch trips the breaker degradation ladder")
 
+    # 6. Fleet failover: 2 replicas, kill r1 mid-sweep — zero lost, migrated
+    # survivors token-identical to the single-engine baseline, r0 serving
+    # throughout, r1 rejoining via its canary probe.
+    from fairness_llm_tpu.config import FleetConfig, IntegrityConfig  # noqa: E402
+    from fairness_llm_tpu.serving import ReplicaSet  # noqa: E402
+
+    fleet_inj = ScriptedFaultInjector(replica_crashes={"r1": 3})
+    fleet = ReplicaSet(
+        engine, SERVING, settings=GREEDY,
+        fleet=FleetConfig(replicas=2, fence_cooldown_s=0.05),
+        resilience=RESILIENCE, fault_injector=fleet_inj,
+        integrity=IntegrityConfig(canary_max_tokens=8),
+    )
+    fleet_reqs = [Request(prompt=p, id=f"fleet_{rid}", settings=GREEDY)
+                  for rid, p in PROMPTS.items()]
+    fleet_results = {r.id: r for r in fleet.serve(fleet_reqs)}
+    check(fleet_inj.replica_faults_fired == [("r1", "replica_crash")],
+          "replica r1 crash fired once mid-sweep")
+    check(set(fleet_results) == {f"fleet_{rid}" for rid in PROMPTS},
+          "fleet: every request got a terminal Result (zero lost)")
+    fleet_parity = True
+    for rid, prompt in PROMPTS.items():
+        res = fleet_results[f"fleet_{rid}"]
+        if not res.ok:
+            fleet_parity = False
+            print(f"  fleet loss: {rid}: {res.finish_reason} ({res.error})")
+            continue
+        got, ref = np.asarray(res.tokens), baseline[rid]
+        n = len(got)
+        if n == 0 or not np.array_equal(got, ref[:n]) \
+                or not np.all(ref[n:] == engine.tokenizer.pad_id):
+            fleet_parity = False
+            print(f"  fleet parity break: {rid}: {list(got)} vs {list(ref)}")
+    check(fleet_parity,
+          "fleet: ALL requests ok, token-identical to the greedy baseline")
+    r0, r1 = fleet.replicas
+    reg = T.get_registry()
+    r0_completed = reg.read_value("serving_completed_total",
+                                  component="serving", replica="r0")
+    check(r0.fences == 0 and r0_completed > 0,
+          f"healthy replica r0 never fenced, served {r0_completed:g} "
+          "request(s) throughout")
+    check(r1.fences == 1, "crashed replica r1 fenced exactly once")
+    migrated = reg.read_value("fleet_migrated_requests_total",
+                              component="fleet")
+    recovered = reg.read_value("fleet_migrated_recovered_total",
+                               component="fleet")
+    check(migrated > 0 and migrated == recovered,
+          f"fleet: migrated ({migrated:g}) == recovered ({recovered:g})")
+    check(fleet.await_recovery(timeout_s=60.0)
+          and reg.read_value("fleet_healthy_replicas", component="fleet") == 2,
+          "crashed replica rejoined via canary probe; fleet whole again")
+    check(fleet.last_failover_s is not None,
+          f"failover recovery measured ({fleet.last_failover_s and round(fleet.last_failover_s, 4)}s "
+          "fence -> first migrated token)")
+
     snap = T.snapshot(T.get_registry())
+    # Unlabeled entries only: the fleet section's per-replica boards write
+    # breaker_transitions_total{replica=...} rows for the SAME (stage, to)
+    # keys, and letting them shadow the single-engine board's entries
+    # would validate r1's rejoin cycle in place of the documented
+    # sections-1-5 cycle.
     trans = {
         (c["labels"].get("stage"), c["labels"].get("to")): c["value"]
-        for c in snap["counters"] if c["name"] == "breaker_transitions_total"
+        for c in snap["counters"]
+        if c["name"] == "breaker_transitions_total"
+        and "replica" not in c["labels"]
     }
     for to in ("open", "half_open", "closed"):
         check(trans.get(("decode", to), 0) >= 1,

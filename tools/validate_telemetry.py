@@ -7,6 +7,7 @@ Usage:
     python tools/validate_telemetry.py <path> --require-serving
     python tools/validate_telemetry.py <path> --require-breaker
     python tools/validate_telemetry.py <path> --require-integrity
+    python tools/validate_telemetry.py <path> --require-fleet
 
 Plain mode checks the schema only (`cli telemetry-report --validate` does
 the same inline). ``--require-serving`` additionally requires nonzero TTFT,
@@ -17,7 +18,12 @@ chaos smoke step produces: breaker_state gauges, a full
 closed->open->half-open->closed transition cycle, and a counted hang.
 ``--require-integrity`` requires the silent-corruption signals the extended
 chaos drill produces: a counted NumericsFault, a manifest digest failure,
-and a canary run with at least one mismatch.
+and a canary run with at least one mismatch. ``--require-fleet`` requires
+the replica-failover signals the fleet drill produces: a nonzero
+``fleet_fenced_total``, ``fleet_migrated_requests_total`` equal to
+``fleet_migrated_recovered_total`` (every migrated request reached a
+terminal Result), and ``fleet_healthy_replicas`` back to
+``fleet_replicas`` (the killed replica rejoined via its canary probe).
 """
 
 from __future__ import annotations
@@ -35,9 +41,62 @@ REQUIRED_SERVING_HISTOGRAMS = ("ttft_s", "queue_wait_s", "per_output_token_s")
 
 def check(path: str, require_serving: bool = False,
           require_breaker: bool = False,
-          require_integrity: bool = False) -> int:
+          require_integrity: bool = False,
+          require_fleet: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
+    if require_fleet:
+        counters = snap.get("counters", [])
+
+        def total(name):
+            return sum(c["value"] for c in counters if c.get("name") == name)
+
+        fenced = total("fleet_fenced_total")
+        if not fenced:
+            problems.append(
+                "fleet_fenced_total is zero (no replica was ever fenced)"
+            )
+        migrated = total("fleet_migrated_requests_total")
+        recovered = total("fleet_migrated_recovered_total")
+        if not migrated:
+            problems.append(
+                "fleet_migrated_requests_total is zero (failover never "
+                "migrated anything)"
+            )
+        elif migrated != recovered:
+            problems.append(
+                f"migrated ({migrated}) != recovered ({recovered}) — "
+                "migrated requests were lost"
+            )
+        # Pair healthy/replicas gauges per LABEL SET: a process can run
+        # more than one fleet (one per sampler tuple, each with its own
+        # {"fleet": name} label), and flattening by name would let one
+        # whole fleet mask another's fenced-forever replica.
+        fleets = {}
+        for g in snap.get("gauges", []):
+            labels = g.get("labels", {})
+            if labels.get("component") != "fleet":
+                continue
+            key = tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "component"
+            ))
+            fleets.setdefault(key, {})[g["name"]] = g["value"]
+        sized = {k: v for k, v in fleets.items()
+                 if v.get("fleet_replicas", 0) >= 2}
+        if not sized:
+            problems.append(
+                "no fleet_replicas gauge >= 2 (no fleet was armed)"
+            )
+        for key, vals in sized.items():
+            replicas = vals["fleet_replicas"]
+            healthy = vals.get("fleet_healthy_replicas", -1)
+            if healthy != replicas:
+                tag = dict(key).get("fleet", "default")
+                problems.append(
+                    f"fleet {tag!r}: fleet_healthy_replicas ({healthy}) != "
+                    f"fleet_replicas ({replicas}) — a fenced replica never "
+                    "rejoined"
+                )
     if require_integrity:
         counters = snap.get("counters", [])
 
@@ -102,10 +161,12 @@ def main() -> int:
     ap.add_argument("--require-serving", action="store_true")
     ap.add_argument("--require-breaker", action="store_true")
     ap.add_argument("--require-integrity", action="store_true")
+    ap.add_argument("--require-fleet", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
-                 require_integrity=a.require_integrity)
+                 require_integrity=a.require_integrity,
+                 require_fleet=a.require_fleet)
 
 
 if __name__ == "__main__":
