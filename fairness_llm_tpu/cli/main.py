@@ -209,8 +209,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="export telemetry here: streamed events.jsonl plus an "
                         "end-of-run registry snapshot (telemetry_snapshot.json "
                         "+ metrics.prom) with TTFT/queue-wait/latency "
-                        "histograms; render with `telemetry-report <dir>` "
+                        "histograms, and the device-step timeline as "
+                        "trace.json; render with `telemetry-report <dir>` "
                         "(see docs/OBSERVABILITY.md)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the device-step timeline (prefill/decode/"
+                        "compile spans, request lanes, per-replica tracks) "
+                        "as Chrome-trace JSON — open at "
+                        "https://ui.perfetto.dev. With --telemetry-dir, "
+                        "<telemetry-dir>/trace.json is written regardless "
+                        "(the copy the validator and --timeline report "
+                        "read); this flag adds an extra copy at PATH, or "
+                        "enables the export without a telemetry dir")
+    p.add_argument("--slo-ttft-p95", type=float, default=None, metavar="S",
+                   help="SLO target: p95 time-to-first-token in seconds "
+                        "(default 2.0); burn rates exported as "
+                        "slo_burn_rate gauges, rendered by `slo-report`")
+    p.add_argument("--slo-e2e-p99", type=float, default=None, metavar="S",
+                   help="SLO target: p99 end-to-end request latency in "
+                        "seconds (default 30.0)")
+    p.add_argument("--slo-error-rate", type=float, default=None, metavar="F",
+                   help="SLO target: allowed failed/expired request "
+                        "fraction (default 0.01)")
+    p.add_argument("--achievable-gbps", type=float, default=None,
+                   help="measured achievable HBM streaming bandwidth for "
+                        "the live achieved_over_achievable roofline gauges "
+                        "(default: 819 spec on TPU, a nominal DDR figure "
+                        "on CPU — indicative only)")
     p.add_argument("--no-save", action="store_true")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
@@ -235,6 +260,28 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["profile_trace_dir"] = args.trace_dir
     if args.telemetry_dir:
         updates["telemetry_dir"] = args.telemetry_dir
+    attribution_flags = (args.trace_out, args.slo_ttft_p95, args.slo_e2e_p99,
+                         args.slo_error_rate, args.achievable_gbps)
+    if any(v is not None for v in attribution_flags):
+        from fairness_llm_tpu.config import TelemetryConfig
+
+        tel_kwargs: Dict = {}
+        if args.trace_out:
+            tel_kwargs["trace_out"] = args.trace_out
+        if args.achievable_gbps is not None:
+            if args.achievable_gbps <= 0:
+                raise SystemExit("--achievable-gbps must be > 0")
+            tel_kwargs["achievable_gbps"] = args.achievable_gbps
+        for val, field, flag in (
+            (args.slo_ttft_p95, "slo_ttft_p95_s", "--slo-ttft-p95"),
+            (args.slo_e2e_p99, "slo_e2e_p99_s", "--slo-e2e-p99"),
+            (args.slo_error_rate, "slo_error_rate", "--slo-error-rate"),
+        ):
+            if val is not None:
+                if val <= 0:
+                    raise SystemExit(f"{flag} must be > 0")
+                tel_kwargs[field] = val
+        updates["telemetry"] = TelemetryConfig(**tel_kwargs)
     if args.max_new_tokens is not None:
         if args.max_new_tokens < 1:
             # A zero cap would reach the engine as a [B, 0] decode buffer and
@@ -358,8 +405,21 @@ def telemetry_report(argv) -> int:
                                  "inside) or a snapshot file")
     ap.add_argument("--validate", action="store_true",
                     help="schema-check the snapshot; non-zero exit on problems")
+    ap.add_argument("--timeline", action="store_true",
+                    help="also summarize the trace.json device-step timeline "
+                         "beside the snapshot: top programs by wall, largest "
+                         "step gaps, request outcomes")
     a = ap.parse_args(argv)
-    from fairness_llm_tpu.telemetry import load_snapshot, render_report, validate_snapshot
+    import json
+    import os
+
+    from fairness_llm_tpu.telemetry import (
+        TRACE_FILENAME,
+        load_snapshot,
+        render_report,
+        summarize_chrome_trace,
+        validate_snapshot,
+    )
 
     snap = load_snapshot(a.path)
     if a.validate:
@@ -372,8 +432,50 @@ def telemetry_report(argv) -> int:
                 print(f"  - {p}")
             return 1
     print(render_report(snap))
+    if a.timeline:
+        trace_dir = a.path if os.path.isdir(a.path) else os.path.dirname(a.path)
+        trace_path = os.path.join(trace_dir, TRACE_FILENAME)
+        if os.path.exists(trace_path):
+            with open(trace_path, encoding="utf-8") as f:
+                print("\n" + summarize_chrome_trace(json.load(f)))
+        else:
+            print(f"\n(no {TRACE_FILENAME} beside the snapshot — run with "
+                  "--trace-out or --telemetry-dir to produce one)")
     if a.validate:
         print("\nsnapshot schema: OK")
+    return 0
+
+
+def slo_report(argv) -> int:
+    """``cli slo-report <dir|snapshot.json>`` — render the SLO burn rates a
+    run recorded: one table per label set (replica in fleet mode), burn per
+    (objective, window), alert counts. Burn 1.0 = consuming the error
+    budget exactly at the sustainable rate; >1 = an SLO on its way to
+    violation. See docs/OBSERVABILITY.md §SLOs and burn rates."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu slo-report",
+        description="Render SLO burn rates from a telemetry snapshot",
+    )
+    ap.add_argument("path", help="telemetry dir (uses telemetry_snapshot.json "
+                                 "inside) or a snapshot file")
+    ap.add_argument("--fail-on-burn", action="store_true",
+                    help="exit non-zero when any run-window burn rate "
+                         "exceeds 1.0 (a CI gate)")
+    a = ap.parse_args(argv)
+    from fairness_llm_tpu.telemetry import load_snapshot, render_slo_report
+
+    snap = load_snapshot(a.path)
+    print(render_slo_report(snap))
+    if a.fail_on_burn:
+        burning = [
+            g for g in snap.get("gauges", [])
+            if g.get("name") == "slo_burn_rate"
+            and g.get("labels", {}).get("window") == "run"
+            and g.get("value", 0.0) > 1.0
+        ]
+        if burning:
+            print(f"\n{len(burning)} SLO(s) burning over the whole run")
+            return 1
     return 0
 
 
@@ -490,6 +592,8 @@ def main(argv=None) -> int:
         # Subcommand dispatch ahead of the study parser (whose --all/--phase
         # group is required and would reject it).
         return telemetry_report(argv[1:])
+    if argv and argv[0] == "slo-report":
+        return slo_report(argv[1:])
     if argv and argv[0] == "resume-serving":
         return resume_serving_cmd(argv[1:])
     args = build_parser().parse_args(argv)
@@ -506,6 +610,24 @@ def main(argv=None) -> int:
         from fairness_llm_tpu import telemetry as T
 
         telemetry_sink = T.configure(config.telemetry_dir)
+    # Performance attribution setup (telemetry/slo.py, telemetry/roofline.py):
+    # install the SLO objectives and the roofline reference BEFORE any
+    # backend/scheduler is built, so every evaluator judges against the
+    # configured targets from its first request.
+    from fairness_llm_tpu.telemetry import (
+        SLOTargets,
+        set_achievable_gbps,
+        set_slo_targets,
+    )
+
+    tc = config.telemetry
+    set_slo_targets(SLOTargets(
+        ttft_p95_s=tc.slo_ttft_p95_s, e2e_p99_s=tc.slo_e2e_p99_s,
+        error_rate=tc.slo_error_rate, fast_window_s=tc.slo_fast_window_s,
+        slow_window_s=tc.slo_slow_window_s,
+    ))
+    if tc.achievable_gbps:
+        set_achievable_gbps(tc.achievable_gbps)
 
     if args.quick:
         args.num_items = min(args.num_items, 10)
@@ -611,6 +733,27 @@ def main(argv=None) -> int:
             if telemetry_sink is not None:
                 T.install_event_sink(None)
                 telemetry_sink.close()
+
+    # Perfetto timeline export. The telemetry dir ALWAYS gets its bundle
+    # copy (trace.json beside the snapshot — what `telemetry-report
+    # --timeline` and `validate_telemetry --require-profile` read);
+    # --trace-out adds/redirects an extra copy at an explicit path.
+    trace_paths = []
+    if config.telemetry_dir:
+        trace_paths.append(f"{config.telemetry_dir}/trace.json")
+    if config.telemetry.trace_out \
+            and config.telemetry.trace_out not in trace_paths:
+        trace_paths.append(config.telemetry.trace_out)
+    if trace_paths:
+        from fairness_llm_tpu.telemetry import get_timeline
+
+        try:
+            for tp in trace_paths:
+                out = get_timeline().export(tp)
+                print(f"device-step timeline: {out} "
+                      "(open at https://ui.perfetto.dev)")
+        except Exception as e:  # noqa: BLE001 — diagnostics must not fail the run
+            logger.warning("timeline export unavailable: %s", e)
 
     print("\n" + "=" * 60)
     print("RUN COMPLETE")

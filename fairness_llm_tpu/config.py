@@ -182,6 +182,40 @@ class IntegrityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Performance-attribution knobs (``telemetry/timeline|roofline|slo``).
+
+    The attribution layer itself is always on (host-side bookkeeping; the
+    bench ``profiling_overhead`` guard pins its cost at harness noise) —
+    these fields control the on-disk trace export, the roofline reference,
+    and the SLO objectives the burn-rate evaluator judges against.
+
+    ``trace_out`` writes the device-step timeline as Chrome-trace JSON
+    (open in Perfetto) at end of run. With ``telemetry_dir`` set,
+    ``<telemetry_dir>/trace.json`` is ALWAYS written (the copy
+    ``validate_telemetry --require-profile`` and ``telemetry-report
+    --timeline`` read) — ``trace_out`` adds an extra copy at an explicit
+    path, or enables the export without a telemetry dir.
+
+    SLO semantics (see ``telemetry/slo.py``): "p95 TTFT <= slo_ttft_p95_s"
+    (at most 5% of requests over), "p99 e2e <= slo_e2e_p99_s" (at most 1%
+    over), error rate <= ``slo_error_rate``; burn rates are computed over a
+    fast window, a slow window, and the whole run.
+    """
+
+    trace_out: Optional[str] = None
+    # Measured achievable streaming bandwidth for achieved_over_achievable
+    # (None = platform default: 819 GB/s v5e spec on TPU, a nominal DDR
+    # figure on the CPU harness — indicative only).
+    achievable_gbps: Optional[float] = None
+    slo_ttft_p95_s: float = 2.0
+    slo_e2e_p99_s: float = 30.0
+    slo_error_rate: float = 0.01
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout. Axes follow the scaling-book convention:
 
@@ -269,6 +303,14 @@ class Config:
     # `cli telemetry-report <dir>`. Instrumentation itself is always on —
     # this knob only controls the on-disk exports. See docs/OBSERVABILITY.md.
     telemetry_dir: Optional[str] = None
+    # Performance attribution: timeline trace export, roofline reference,
+    # SLO targets (--trace-out and the --slo-* flags). The device-step
+    # timeline + compile stats + roofline gauges record regardless; this
+    # only shapes exports and objectives. See docs/OBSERVABILITY.md
+    # §Performance attribution.
+    telemetry: TelemetryConfig = dataclasses.field(
+        default_factory=TelemetryConfig
+    )
     # Prompt-lookup speculative decoding for greedy sweeps (off by default:
     # the stock study settings sample at temperature 0.7, where speculation
     # cannot apply — see SpeculationConfig).

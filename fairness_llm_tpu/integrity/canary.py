@@ -32,12 +32,14 @@ the serving path is byte-identical (pinned in tests/test_integrity.py).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Mapping, Optional
 
 import numpy as np
 
 from fairness_llm_tpu.config import ModelSettings
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.timeline import get_timeline
 
 logger = logging.getLogger(__name__)
 
@@ -126,7 +128,15 @@ class CanaryProbe:
             prompt=self.prompt, id=f"__canary_{self._seq}__",
             settings=self.settings, row_seed=0,
         )
+        probe_t0 = time.monotonic()
         res = scheduler.serve([req])[0]
+        # The probe as a first-class span on the probed track — a canary-
+        # heavy run shows its overhead directly on the Perfetto timeline.
+        get_timeline().record_span(
+            "canary_probe", "canary",
+            self.labels.get("replica") or self.component,
+            probe_t0, time.monotonic() - probe_t0,
+        )
         got = np.asarray(res.tokens)
         n = len(got)
         ok = bool(

@@ -54,6 +54,9 @@ from fairness_llm_tpu.runtime.sampling import (
 )
 from fairness_llm_tpu.runtime.speculative import ngram_draft
 from fairness_llm_tpu.telemetry import get_registry
+from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.roofline import observe_decode
+from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.utils.profiling import SpeculationStats
 
 logger = logging.getLogger(__name__)
@@ -243,6 +246,7 @@ class DecodeEngine:
         (k, v) arrays [Pc, Hkv, D] every batch row reads (but never copies)."""
         key = ("prefix", prefix_len)
         fn = self._compiled.get(key)
+        note_lookup("prefix", hit=fn is not None)
         if fn is not None:
             return fn
         cfg = self.config
@@ -287,6 +291,7 @@ class DecodeEngine:
         key = ("decode", batch, prompt_len, max_new, sampler_settings,
                prefix_len, guard)
         fn = self._compiled.get(key)
+        note_lookup("decode", hit=fn is not None)
         if fn is not None:
             return fn
 
@@ -394,6 +399,7 @@ class DecodeEngine:
         key = ("spec_decode", batch, prompt_len, max_new, prefix_len,
                guard, spec.ngram_max, k)
         fn = self._compiled.get(key)
+        note_lookup("spec_decode", hit=fn is not None)
         if fn is not None:
             return fn
 
@@ -735,8 +741,12 @@ class DecodeEngine:
         # Snapshot for the watchdog's compile exemption below: if this call
         # grows the compiled-program cache (first use of a shape, a VMEM/
         # spec fallback rebuild, a fresh prefix KV), its wall includes
-        # compile time and must not classify as a hang.
+        # compile time and must not classify as a hang. The KEY set (not
+        # just the count) also feeds compile observability: every key the
+        # call adds is one fresh compilation attributed the call's wall.
+        keys_before = set(self._compiled)
         n_compiled_before = len(self._compiled)
+        t0_mono = time.monotonic()
         fn = build_fn()
         tokens_j = jnp.asarray(tokens)
         valid_j = jnp.asarray(valid)
@@ -925,6 +935,7 @@ class DecodeEngine:
         # warmed steady-state calls dominate a sweep, and the histogram's
         # max/percentile spread is exactly how a cold compile shows up.
         reg = get_registry()
+        wall = time.perf_counter() - t_start
         reg.counter("generate_calls_total", component="engine").inc()
         reg.counter("prompt_tokens_total", component="engine").inc(
             int(sum(len(r) for r in rows))
@@ -936,9 +947,7 @@ class DecodeEngine:
             "decode_paths_total", component="engine",
             path="speculative" if use_spec else "plain",
         ).inc()
-        reg.histogram("generate_wall_s", component="engine").observe(
-            time.perf_counter() - t_start
-        )
+        reg.histogram("generate_wall_s", component="engine").observe(wall)
         if spec_stats is not None:
             spec_stats.publish(reg)
         stats: Dict[str, Any] = {
@@ -950,4 +959,35 @@ class DecodeEngine:
         }
         if spec_stats is not None:
             stats["speculation"] = spec_stats.as_dict()
+        # Performance attribution (telemetry/): the call as a span on the
+        # "engine" timeline track; every compile key the call added as a
+        # fresh compilation (the span's wall is the compile-dominated upper
+        # bound each key gets — in practice one call compiles at most a
+        # prefix program + one decode program); the live roofline gauges.
+        # The span runs from t0_mono (post-tokenize, where the device work
+        # starts) to NOW on the same clock — `wall` above starts at t_start
+        # and would overrun the call's real end by the tokenize/pad time.
+        wall_mono = time.monotonic() - t0_mono
+        path = "speculative" if use_spec else "plain"
+        get_timeline().record_span(
+            f"generate[{batch}x{prompt_len}]",
+            "speculate" if use_spec else "decode", "engine", t0_mono,
+            wall_mono, path=path, prefix_len=prefix_len,
+        )
+        for key in set(self._compiled) - keys_before:
+            if key[0] != "prefix_kv":  # cached KV arrays, not a program
+                record_compile(key[0], reason="shape", seconds=wall_mono,
+                               track="engine", key=key, t0=t0_mono)
+        if use_spec:
+            steps_done = spec_stats.verify_steps
+        else:
+            # Plain-path trip count: the while_loop runs until the slowest
+            # row finishes, so steps == the max per-row emitted count.
+            per_row = np.sum(out != self.tokenizer.pad_id, axis=1)
+            steps_done = int(per_row.max()) if per_row.size else 0
+        # wall_mono still includes prefill + detokenize, so the fraction is
+        # a lower bound on steady-state decode efficiency — the serving
+        # scheduler's per-chunk numbers are the precise ones.
+        observe_decode(self.config, stats, steps_done, wall_mono,
+                       program="spec_decode" if use_spec else "decode")
         return GenerateOutput(texts=texts, tokens=out, steps=max_new, stats=stats)

@@ -77,6 +77,7 @@ from fairness_llm_tpu.serving.request import Request, Result
 from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
 
@@ -310,6 +311,12 @@ class ReplicaSet:
                 self._fence(rep, injected)
                 progressed = True
                 continue
+            # Decay the replica's SLO burn windows even when it is IDLE —
+            # step() only runs with work, and the router reads this
+            # replica's fast-window burn on every placement: a
+            # burning-then-shedded replica must recover by the window
+            # aging out, not by waiting for a trickle request to finalize.
+            rep.sched.tracer.slo.maybe_evaluate()
             if rep.sched.has_work:
                 progressed |= rep.sched.step(rep.stats)
                 self._collect(rep)
@@ -466,6 +473,8 @@ class ReplicaSet:
         emit_event("replica_fenced", replica=rep.name, reason=reason,
                    live=rep.sched.pool.occupancy,
                    queued=len(rep.sched.queue))
+        get_timeline().record_instant("fence", rep.name, t=now,
+                                      reason=reason)
         logger.warning(
             "fencing replica %s (%s): %d live, %d queued — draining and "
             "migrating", rep.name, reason, rep.sched.pool.occupancy,
@@ -509,6 +518,8 @@ class ReplicaSet:
                         **self._fleet_labels).inc(newly_migrated)
         if migrated:
             self._failover_pending = True
+            get_timeline().record_instant("migrate", rep.name,
+                                          migrated=migrated)
         emit_event("replica_fence_complete", replica=rep.name,
                    reason=reason, migrated=migrated)
 
@@ -551,6 +562,7 @@ class ReplicaSet:
                                replica=rep.name).inc()
         self._update_health_gauge()
         emit_event("replica_rejoined", replica=rep.name)
+        get_timeline().record_instant("rejoin", rep.name)
         logger.warning("replica %s passed its rejoin probe; back in the "
                        "fleet", rep.name)
         return True

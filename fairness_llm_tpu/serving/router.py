@@ -16,6 +16,11 @@ least-loaded replica:
 - **canary freshness** (``canary_last_ok`` gauge): a replica whose last
   canary MISMATCHED is producing wrong-but-finite output — discounted
   hardest of all, since its breakers may look healthy;
+- **SLO burn rate** (``slo_burn_rate{slo="error_rate", window="fast"}``,
+  telemetry/slo.py): a replica burning its fast-window error budget is
+  failing users even when no breaker has opened (deadline expiries,
+  contained requeues) — discounted by 1/burn, floored so recovery traffic
+  still flows;
 - **load**: live slots + queued depth relative to capacity, plus the
   ``queue_depth_hwm`` high-water gauge the scheduler now maintains (an
   instantaneous depth of 0 right after a burst says "idle"; the high-water
@@ -55,6 +60,11 @@ OPEN_BREAKER_DISCOUNT = 0.10
 HALF_OPEN_BREAKER_DISCOUNT = 0.50
 DEGRADATION_RUNG_DISCOUNT = 0.25  # per ladder level
 CANARY_MISMATCH_DISCOUNT = 0.25
+# SLO burn-rate discount floor (telemetry/slo.py): a replica burning its
+# fast-window error budget at rate B scores 1/B of healthy, floored here so
+# a burning-but-alive replica still takes a trickle (same rationale as the
+# OPEN_BREAKER floor: total starvation just thundering-herds recovery).
+SLO_BURN_DISCOUNT_FLOOR = 0.20
 
 
 class HealthRouter:
@@ -93,6 +103,17 @@ class HealthRouter:
             )
             if last_ok == 0.0:
                 score *= CANARY_MISMATCH_DISCOUNT
+            # SLO burn rate (telemetry/slo.py): the replica's own tracer
+            # evaluates per terminal request; the fast-window error burn is
+            # the earliest "this replica is failing its users" signal —
+            # requests can fail/expire without any breaker ever opening
+            # (deadline expiries under load, contained requeues).
+            burn = get_registry().read_value(
+                "slo_burn_rate", default=0.0, component="serving",
+                replica=replica.name, slo="error_rate", window="fast",
+            )
+            if burn > 1.0:
+                score *= max(SLO_BURN_DISCOUNT_FLOOR, 1.0 / burn)
         get_registry().gauge(
             "replica_health_score", component="fleet", replica=replica.name
         ).set(score)

@@ -96,6 +96,9 @@ from fairness_llm_tpu.telemetry import (
     emit_event,
     get_registry,
 )
+from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.roofline import observe_decode
+from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.integrity.numerics import check_finite, masked_finite
 from fairness_llm_tpu.utils.failures import (
     DecodeFault,
@@ -153,6 +156,9 @@ class ContinuousScheduler:
         # adds no label: metric keys are byte-identical to before.
         self.replica = replica
         self.labels = {"replica": replica} if replica else {}
+        # Timeline lane (telemetry/timeline.py): fleet replicas get their
+        # own track; the single-engine path shares one "serving" lane.
+        self._track = replica or "serving"
         self.sampler = SamplerSettings(
             temperature=self.settings.temperature,
             top_k=self.settings.top_k,
@@ -283,6 +289,7 @@ class ContinuousScheduler:
         """
         key = ("serve_prefill", nb, P, guard)
         fn = self._compiled.get(key)
+        note_lookup("serve_prefill", hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
         cfg = self.engine.config
@@ -356,6 +363,7 @@ class ContinuousScheduler:
         guard = self._guard()
         key = ("serve_step", self.decode_chunk, guard)
         fn = self._compiled.get(key)
+        note_lookup("serve_step", hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
         cfg = self.engine.config
@@ -564,6 +572,10 @@ class ContinuousScheduler:
             self._execute_drain(stats)
             return False
         self._apply_degradation()
+        # SLO window decay (telemetry/slo.py): throttled re-evaluation so
+        # the fast/slow burn gauges age out during quiet stretches instead
+        # of freezing at the last terminal request's value.
+        self.tracer.slo.maybe_evaluate()
         progressed = self._iterate(stats)
         self._feed(stats)
         self._heartbeat.poke(
@@ -591,6 +603,9 @@ class ContinuousScheduler:
         get_registry().gauge(
             "queue_depth_hwm", component="serving", **self.labels
         ).set(len(self.queue))
+        # Step-gap cursor reset: the idle stretch between this drain and the
+        # next one's first chunk is not per-step host sync.
+        get_timeline().clear_track_cursor(self._track)
 
     def _run_loop(self, stats: ServingStats) -> None:
         self._feed(stats)
@@ -956,9 +971,23 @@ class ContinuousScheduler:
             return True
         if self.breakers is not None:
             self.breakers.record_success("prefill")
+        pf_wall = time.monotonic() - pf_t0
         get_registry().histogram(
             "prefill_wall_s", component="serving", **self.labels
-        ).observe(time.monotonic() - pf_t0)
+        ).observe(pf_wall)
+        # Timeline span + compile accounting (telemetry/timeline.py,
+        # telemetry/compilestats.py): one span per compiled prefill batch on
+        # this scheduler's track; a first-use shape records its (compile-
+        # dominated) first-call wall under compiles_total/compile_seconds.
+        get_timeline().record_span(
+            f"prefill[{nb}x{P}]", "prefill", self._track, pf_t0, pf_wall,
+            rows=len(admitted),
+        )
+        if first_compile:
+            record_compile("serve_prefill", reason="shape", seconds=pf_wall,
+                           track=self._track, key=("serve_prefill", nb, P,
+                                                   guard),
+                           labels=self.labels, t0=pf_t0)
         stats.prefill_batches += 1
         stats.prefill_tokens += int(tb.lengths.sum())
         stats.admitted += len(admitted)
@@ -1026,6 +1055,7 @@ class ContinuousScheduler:
         first_compile = ("serve_step", self.decode_chunk, guard) \
             not in self._compiled
         fn = self._step_fn()
+        dc_t0 = time.monotonic()
         if self.watchdog is not None:
             self.watchdog.arm("decode")
         try:
@@ -1089,6 +1119,32 @@ class ContinuousScheduler:
         stats.decode_steps += steps
         stats.occupancy_sum += int(counters[1])
         now = time.monotonic()
+        # Performance attribution (telemetry/): the chunk's span on this
+        # scheduler's timeline track (the gap to the previous chunk feeds
+        # the step_gap_s histogram — the per-step host sync ROADMAP item 3
+        # wants to eliminate), first-use compiles under compiles_total, and
+        # the live roofline gauges. The byte model streams the WHOLE pool's
+        # KV per step (the compiled program does, live rows or not), so
+        # batch is num_slots, not len(live_ids).
+        dc_wall = now - dc_t0
+        get_timeline().decode_chunk(self._track, dc_t0, dc_wall, steps,
+                                    labels=self.labels, rows=len(live_ids))
+        if first_compile:
+            record_compile(
+                "serve_step",
+                reason=("decode_chunk"
+                        if self.decode_chunk != self._base_decode_chunk
+                        else "shape"),
+                seconds=dc_wall, track=self._track,
+                key=("serve_step", self.decode_chunk, guard),
+                labels=self.labels, t0=dc_t0,
+            )
+        observe_decode(
+            self.engine.config,
+            {"batch": self.num_slots, "cache_slots": self.cache_len,
+             "prefix_len": 0},
+            steps, dc_wall, program="serve_step", labels=self.labels,
+        )
         # Per-chunk pool-pressure samples, weighted by the steps the chunk
         # actually ran (the compiled loop may exit early): live rows at
         # entry is the occupancy every one of those steps decoded at most.

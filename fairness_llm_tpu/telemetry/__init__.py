@@ -17,7 +17,20 @@ attribution, occupancy over time. This package is the shared substrate:
   yielding queue-wait / TTFT / per-output-token / e2e histograms.
 - ``export``    — JSONL event sink, snapshot dump (JSON + Prometheus text),
   schema validation, and the ``cli telemetry-report`` terminal renderer.
-- ``heartbeat`` — low-frequency liveness pulse for long sweeps.
+- ``heartbeat`` — low-frequency liveness pulse for long sweeps, with
+  missed-beat gap detection (``heartbeat_gap_s``).
+- ``timeline``  — device-step timeline: spans for every compiled-program
+  invocation + scheduler instants + request lanes, per-replica tracks,
+  Chrome-trace/Perfetto export (``--trace-out``), and the ``step_gap_s``
+  host-sync histogram.
+- ``compilestats`` — compile observability: ``compiles_total{program,
+  reason}``, first-call ``compile_seconds``, cache hit/miss counters.
+- ``roofline``  — the bytes-per-step model as LIVE gauges
+  (``decode_step_bytes`` / ``achieved_hbm_gbps`` /
+  ``achieved_over_achievable`` per program/replica).
+- ``slo``       — SLO targets + multi-window burn rates
+  (``slo_burn_rate{slo,window}``) and alert events; rendered by the
+  ``slo-report`` CLI subcommand, consumed by the fleet router.
 
 Instrumentation is always-on (host-side integer arithmetic, zero device
 cost); the EXPORTERS are opt-in via ``--telemetry-dir``. The pre-existing
@@ -51,6 +64,31 @@ from fairness_llm_tpu.telemetry.export import (
     to_prometheus,
     validate_snapshot,
     write_snapshot,
+)
+from fairness_llm_tpu.telemetry.timeline import (
+    TRACE_FILENAME,
+    Timeline,
+    attribution_on,
+    get_timeline,
+    set_attribution,
+    set_timeline,
+    summarize_chrome_trace,
+    use_timeline,
+    validate_chrome_trace,
+)
+from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.roofline import (
+    decode_step_bytes,
+    observe_decode,
+    reference_achievable_gbps,
+    set_achievable_gbps,
+)
+from fairness_llm_tpu.telemetry.slo import (
+    SLOEvaluator,
+    SLOTargets,
+    get_slo_targets,
+    render_slo_report,
+    set_slo_targets,
 )
 from fairness_llm_tpu.telemetry.tracing import (
     RequestTracer,
@@ -127,4 +165,24 @@ __all__ = [
     "event_sink",
     "emit_event",
     "configure",
+    "TRACE_FILENAME",
+    "Timeline",
+    "get_timeline",
+    "set_timeline",
+    "use_timeline",
+    "attribution_on",
+    "set_attribution",
+    "validate_chrome_trace",
+    "summarize_chrome_trace",
+    "note_lookup",
+    "record_compile",
+    "decode_step_bytes",
+    "observe_decode",
+    "reference_achievable_gbps",
+    "set_achievable_gbps",
+    "SLOEvaluator",
+    "SLOTargets",
+    "get_slo_targets",
+    "set_slo_targets",
+    "render_slo_report",
 ]

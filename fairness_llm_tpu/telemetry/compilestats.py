@@ -1,0 +1,74 @@
+"""Compile observability: counters/histograms/spans for XLA compilation.
+
+The engine and the serving scheduler both keep hand-rolled compiled-program
+caches keyed on shape tuples (``DecodeEngine._compiled``,
+``ContinuousScheduler._compiled``), and the key space has been multiplying:
+the numerics-guard flag doubled every key (PR 5), the degradation ladder
+made ``decode_chunk`` mutable mid-run (PR 4), fleets build per-replica
+schedulers with their own caches (PR 6). A recompile storm — the ladder
+flapping between chunk sizes, a workload cycling prompt buckets — today
+shows up only as mysteriously slow steps. These helpers make it first-class:
+
+- ``compiles_total{program, reason}`` — one count per freshly-built
+  compiled program (reason: ``shape`` = first use of a shape bucket,
+  ``decode_chunk`` = the ladder resized the chunk mid-run);
+- ``compile_seconds{program}`` — the first-invocation wall of each fresh
+  program. jit compiles lazily on first call, so this is compile time plus
+  one execution — an upper bound that is compile-dominated in practice,
+  and exactly the stall a request experiences behind it;
+- ``compile_cache_hits_total`` / ``compile_cache_misses_total{program}`` —
+  per-lookup hit/miss on the existing compile keys, so cache churn is
+  visible even when the recompiles themselves are cheap;
+- a ``cat="compile"`` span on the timeline (``telemetry/timeline.py``), so
+  a recompile storm renders as a wall of compile blocks in the Perfetto
+  trace, and a ``compile`` JSONL event when a sink is installed.
+
+Gated, like the whole attribution layer, on ``timeline.attribution_on()``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from fairness_llm_tpu.telemetry.registry import get_registry
+from fairness_llm_tpu.telemetry.timeline import attribution_on, get_timeline
+
+
+def note_lookup(program: str, hit: bool,
+                labels: Optional[Dict[str, str]] = None) -> None:
+    """Count one compiled-program cache lookup on its existing compile key."""
+    if not attribution_on():
+        return
+    name = ("compile_cache_hits_total" if hit
+            else "compile_cache_misses_total")
+    get_registry().counter(
+        name, component="compile", program=program, **(labels or {})
+    ).inc()
+
+
+def record_compile(program: str, reason: str, seconds: float,
+                   track: str = "engine", key=None,
+                   labels: Optional[Dict[str, str]] = None,
+                   t0: Optional[float] = None) -> None:
+    """Record one fresh compilation: counters, the first-call wall
+    histogram, a timeline span, and a JSONL event. ``key`` is the compile
+    key for diagnostics; ``t0`` the monotonic start of the compiling call
+    (defaults to now - seconds)."""
+    if not attribution_on():
+        return
+    lbl = labels or {}
+    reg = get_registry()
+    reg.counter("compiles_total", component="compile", program=program,
+                reason=reason, **lbl).inc()
+    reg.histogram("compile_seconds", component="compile",
+                  program=program).observe(seconds)
+    start = (time.monotonic() - seconds) if t0 is None else t0
+    get_timeline().record_span(
+        f"compile:{program}", "compile", track, start, seconds,
+        reason=reason, key=repr(key),
+    )
+    from fairness_llm_tpu.telemetry import emit_event  # lazy: no cycle
+
+    emit_event("compile", program=program, reason=reason,
+               seconds=round(float(seconds), 4), key=repr(key), **lbl)

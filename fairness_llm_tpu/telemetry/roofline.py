@@ -1,0 +1,132 @@
+"""Live roofline accounting: the bytes-per-step model as registry gauges.
+
+The decode step is memory-bound: its floor is (HBM bytes the step must
+stream) / (bandwidth the chip can actually sustain). That model existed
+only offline — ``bench.py`` computed ``decode_step_bytes`` per bench round
+and ``tools/account_decode_step.py`` classified a captured device trace —
+so the BENCH_r03-r05 headline (``achieved_over_achievable`` stuck at
+0.4-0.5) could not be watched during a run, per replica, per program. This
+module folds the same byte model into live gauges fed per decode chunk:
+
+- ``decode_step_bytes{program, ...}`` — HBM bytes one step of this compiled
+  program streams (params at compute width + the pool's KV slots + shared
+  prefix KV), the model ``bench.decode_step_bytes`` now imports from here;
+- ``achieved_hbm_gbps{program, ...}`` — bytes * steps / wall for the last
+  chunk, plus an ``achieved_hbm_gbps_dist`` histogram of the same;
+- ``achieved_over_achievable{program, ...}`` — the headline fraction
+  against this platform's reference streaming bandwidth.
+
+The reference bandwidth is the v5e spec roofline (819 GB/s) on TPU; off-TPU
+(the CPU test harness) a nominal DDR-class figure keeps the fraction
+defined — INDICATIVE only, the real gate stays the bench's in-run measured
+``achievable_gbps`` (``bench.measure_achievable_gbps``). Override with
+``set_achievable_gbps`` (``TelemetryConfig.achievable_gbps``) when a
+measured figure exists.
+
+Gated, like the whole attribution layer, on ``timeline.attribution_on()``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from fairness_llm_tpu.telemetry.registry import get_registry
+from fairness_llm_tpu.telemetry.timeline import attribution_on
+
+V5E_HBM_GBPS = 819.0  # v5e spec HBM bandwidth — the TPU roofline reference
+# Off-TPU fallback so achieved_over_achievable stays defined on the CPU
+# harness: a nominal DDR4-class streaming figure. Indicative only.
+CPU_NOMINAL_GBPS = 16.0
+
+_achievable_override: Optional[float] = None
+
+
+def set_achievable_gbps(gbps: Optional[float]) -> None:
+    """Install a measured achievable-bandwidth reference (None restores the
+    platform default). ``TelemetryConfig.achievable_gbps`` routes here."""
+    global _achievable_override
+    _achievable_override = float(gbps) if gbps else None
+
+
+def reference_achievable_gbps() -> float:
+    """The denominator of ``achieved_over_achievable``: the override when
+    installed, else the platform default (v5e spec on TPU, nominal DDR
+    figure elsewhere)."""
+    if _achievable_override is not None:
+        return _achievable_override
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no jax, no platform hint
+        backend = "cpu"
+    return V5E_HBM_GBPS if backend == "tpu" else CPU_NOMINAL_GBPS
+
+
+def decode_step_bytes(config, stats) -> int:
+    """HBM bytes one decode step must stream (the decode-time roofline
+    model; moved here from bench.py so serving can evaluate it live).
+
+    Per step: every parameter once (matmuls touch all weights), each row's
+    KV cache (its remainder-prompt + generated slots), and the shared
+    prefix KV once per step (read once for the whole batch — the
+    prefix-cache win). ``stats`` carries ``batch`` / ``cache_slots`` /
+    ``prefix_len`` (the ``GenerateOutput.stats`` shape).
+
+    Param width: the COMPUTE dtype, not the storage dtype — the round-3
+    device trace shows XLA hoists the f32->bf16 cast of a bf16-config
+    model's f32-stored tree out of the decode loop, so each step streams
+    2 bytes/param even when storage is f32. Using the storage width
+    overstated step bytes ~25% and inflated achieved_hbm_gbps accordingly.
+    """
+    model_item = 2 if config.dtype == "bfloat16" else 4
+    if config.weight_quant == "int8":
+        # Matmul kernels stream int8 (dequant-in-tile, ops/quant_matmul.py);
+        # embeddings/norms stay float. quantized = approx - embed whether or
+        # not embeddings are tied (the untied lm_head is itself quantized).
+        embed = config.vocab_size * config.d_model
+        params = (config.approx_param_count - embed) * 1 + embed * model_item
+    else:
+        params = config.approx_param_count * model_item
+    if config.kv_cache_quant:
+        # int8 values + the per-(slot, head) f32 scale the step also reads —
+        # same accounting as parallel/sharding.per_device_kv_cache_bytes.
+        per_head_slot = config.head_dim * 1 + 4
+    else:
+        per_head_slot = config.head_dim * model_item
+    per_slot = config.num_kv_heads * per_head_slot * 2 * config.num_layers
+    kv = stats["batch"] * stats["cache_slots"] * per_slot
+    # _prefix_fn dequantizes the shared prefix to the model dtype, so its
+    # per-step read is model-dtype-wide even under kv_cache_quant.
+    prefix = stats["prefix_len"] * (
+        config.num_kv_heads * config.head_dim * model_item * 2
+        * config.num_layers
+    )
+    return params + kv + prefix
+
+
+def observe_decode(config, stats: Dict, steps: int, wall_s: float,
+                   program: str,
+                   labels: Optional[Dict[str, str]] = None) -> Optional[Dict]:
+    """Fold one decode invocation into the live roofline gauges. ``stats``
+    as in ``decode_step_bytes``; ``steps`` the decode steps the call
+    actually ran; ``wall_s`` its host wall. Returns the computed numbers
+    (or None when gated off / nothing ran)."""
+    if not attribution_on() or steps <= 0 or wall_s <= 0:
+        return None
+    lbl = labels or {}
+    step_bytes = decode_step_bytes(config, stats)
+    gbps = step_bytes * steps / wall_s / 1e9
+    achievable = reference_achievable_gbps()
+    frac = gbps / achievable if achievable > 0 else 0.0
+    reg = get_registry()
+    reg.gauge("decode_step_bytes", component="roofline", program=program,
+              **lbl).set(step_bytes)
+    reg.gauge("achieved_hbm_gbps", component="roofline", program=program,
+              **lbl).set(gbps)
+    reg.histogram("achieved_hbm_gbps_dist", component="roofline",
+                  program=program, **lbl).observe(gbps)
+    reg.gauge("achieved_over_achievable", component="roofline",
+              program=program, **lbl).set(frac)
+    return {"step_bytes": step_bytes, "gbps": gbps,
+            "achievable_gbps": achievable, "fraction": frac}

@@ -46,6 +46,8 @@ from fairness_llm_tpu.telemetry.registry import (
     MetricsRegistry,
     get_registry,
 )
+from fairness_llm_tpu.telemetry.slo import SLOEvaluator
+from fairness_llm_tpu.telemetry.timeline import get_timeline
 
 # Canonical event names, in lifecycle order. ``requeued`` may appear between
 # admitted and a later (second) admitted; terminal events appear exactly once.
@@ -102,6 +104,15 @@ class RequestTracer:
         self._events: Dict[str, List[SpanEvent]] = {}
         self.finished: Deque[Tuple[TraceSummaryRow, List[SpanEvent]]] = \
             collections.deque(maxlen=keep_finished)
+        # SLO burn-rate evaluator (telemetry/slo.py), fed once per terminal
+        # request from finalize — same labels as every other instrument this
+        # tracer writes, so a fleet's replicas burn independently.
+        self.slo = SLOEvaluator(component=component, labels=self.labels)
+
+    def _track(self) -> str:
+        """Timeline lane for this tracer's scheduler: the replica name in
+        fleet mode, else the component (``"serving"``)."""
+        return self.labels.get("replica") or self.component
 
     def _reg(self) -> MetricsRegistry:
         return self._registry if self._registry is not None else get_registry()
@@ -118,6 +129,12 @@ class RequestTracer:
 
         emit_event("span", request_id=request_id, event=event, t=ev.t,
                    component=self.component, **self.labels)
+        # Timeline bridge: every lifecycle edge is an instant on this
+        # scheduler's request lane — admissions/evictions/requeues/fences
+        # read directly off the Perfetto timeline, on the right replica
+        # track (telemetry/timeline.py; no-op when attribution is off).
+        get_timeline().record_instant(event, self._track(), t=ev.t,
+                                      cat="lifecycle", request_id=request_id)
         return ev
 
     def events(self, request_id: str) -> List[SpanEvent]:
@@ -170,6 +187,15 @@ class RequestTracer:
                     outcome=outcome, **lbl).inc()
         if tokens:
             reg.counter("output_tokens_total", component=c, **lbl).inc(tokens)
+        # Request lane span (submitted -> terminal) over the device-step
+        # lane, and the SLO evaluator's per-request observation — both
+        # no-ops when attribution is off.
+        get_timeline().record_request(
+            request_id, self._track(),
+            submitted if submitted is not None else end, end, outcome,
+            tokens=tokens,
+        )
+        self.slo.observe(outcome, ttft_s=row.ttft_s, e2e_s=row.e2e_s, t=end)
         self.finished.append((row, evs))  # evs already ends with the terminal
         return row
 

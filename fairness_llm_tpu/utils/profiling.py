@@ -28,18 +28,32 @@ logger = logging.getLogger(__name__)
 
 @contextlib.contextmanager
 def maybe_trace(trace_dir: Optional[str], label: str = "region") -> Iterator[None]:
-    if not trace_dir:
-        yield
-        return
-    import jax
+    # The region ALWAYS lands on the host timeline (telemetry/timeline.py)
+    # as a cat="phase" span — the Perfetto export (--trace-out) then shows
+    # phase1/2/3 regions over the device-step lanes, with or without an
+    # XProf capture riding along. Device-side capture stays gated on
+    # trace_dir exactly as before.
+    from fairness_llm_tpu.telemetry.timeline import get_timeline
 
-    logger.info("profiling %s -> %s", label, trace_dir)
-    # Annotate the traced region with its label: a multi-phase --all capture
-    # writes one timestamped directory per phase, but inside XProf the host
-    # planes were indistinguishable — the TraceAnnotation puts "phase1" /
-    # "phase2" / "phase3" spans on the trace-viewer timeline itself.
-    with jax.profiler.trace(trace_dir), jax.profiler.TraceAnnotation(label):
-        yield
+    t0 = time.monotonic()
+    try:
+        if not trace_dir:
+            yield
+            return
+        import jax
+
+        logger.info("profiling %s -> %s", label, trace_dir)
+        # Annotate the traced region with its label: a multi-phase --all
+        # capture writes one timestamped directory per phase, but inside
+        # XProf the host planes were indistinguishable — the TraceAnnotation
+        # puts "phase1" / "phase2" / "phase3" spans on the trace-viewer
+        # timeline itself.
+        with jax.profiler.trace(trace_dir), jax.profiler.TraceAnnotation(label):
+            yield
+    finally:
+        get_timeline().record_span(label, "phase", "host", t0,
+                                   time.monotonic() - t0,
+                                   xprof=bool(trace_dir))
 
 
 @dataclasses.dataclass

@@ -106,6 +106,11 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--telemetry-dir", default=None,
                     help="write events.jsonl + the validated snapshot here")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the device-step timeline as Chrome-trace "
+                         "JSON (default <telemetry-dir>/trace.json) — the "
+                         "drill's faults/fences/migrations render as "
+                         "per-replica Perfetto lanes")
     ap.add_argument("--journal-dir", default=None,
                     help="serving journal dir (default: a temp dir)")
     a = ap.parse_args()
@@ -325,6 +330,14 @@ def main() -> int:
         if sink is not None:
             T.install_event_sink(None)
             sink.close()
+    trace_path = a.trace_out or (os.path.join(a.telemetry_dir, "trace.json")
+                                 if a.telemetry_dir else None)
+    if trace_path:
+        T.get_timeline().export(trace_path)
+        tbad = T.validate_chrome_trace(
+            T.get_timeline().to_chrome_trace()
+        )
+        check(not tbad, f"device-step timeline valid ({trace_path})")
 
     print(f"\nchaos drill: {'PASS' if not problems else 'FAIL'} "
           f"({len(problems)} problem(s))")

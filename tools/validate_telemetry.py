@@ -8,6 +8,7 @@ Usage:
     python tools/validate_telemetry.py <path> --require-breaker
     python tools/validate_telemetry.py <path> --require-integrity
     python tools/validate_telemetry.py <path> --require-fleet
+    python tools/validate_telemetry.py <path> --require-profile
 
 Plain mode checks the schema only (`cli telemetry-report --validate` does
 the same inline). ``--require-serving`` additionally requires nonzero TTFT,
@@ -24,6 +25,11 @@ the replica-failover signals the fleet drill produces: a nonzero
 ``fleet_migrated_recovered_total`` (every migrated request reached a
 terminal Result), and ``fleet_healthy_replicas`` back to
 ``fleet_replicas`` (the killed replica rejoined via its canary probe).
+``--require-profile`` requires the performance-attribution signals
+(ISSUE 7): nonzero compile events (``compiles_total``), a populated
+``achieved_over_achievable`` roofline gauge, a nonzero ``step_gap_s``
+histogram, and a schema-valid ``trace.json`` beside the snapshot
+containing prefill + decode spans and request lanes.
 """
 
 from __future__ import annotations
@@ -42,9 +48,12 @@ REQUIRED_SERVING_HISTOGRAMS = ("ttft_s", "queue_wait_s", "per_output_token_s")
 def check(path: str, require_serving: bool = False,
           require_breaker: bool = False,
           require_integrity: bool = False,
-          require_fleet: bool = False) -> int:
+          require_fleet: bool = False,
+          require_profile: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
+    if require_profile:
+        problems.extend(_check_profile(path, snap))
     if require_fleet:
         counters = snap.get("counters", [])
 
@@ -155,6 +164,49 @@ def check(path: str, require_serving: bool = False,
     return 0
 
 
+def _check_profile(path: str, snap: dict) -> list:
+    """The --require-profile gate: compile events, roofline gauges, step
+    gaps, and a schema-valid trace.json with the span kinds the ISSUE-7
+    acceptance names (prefill/decode/request on tracks)."""
+    import json
+
+    from fairness_llm_tpu.telemetry import TRACE_FILENAME, validate_chrome_trace
+
+    problems = []
+    if not sum(c["value"] for c in snap.get("counters", [])
+               if c.get("name") == "compiles_total"):
+        problems.append("compiles_total is zero (no compile event recorded)")
+    aoa = [g for g in snap.get("gauges", [])
+           if g.get("name") == "achieved_over_achievable"]
+    if not aoa:
+        problems.append("no achieved_over_achievable gauge (roofline "
+                        "accounting never ran)")
+    elif not any(g["value"] > 0 for g in aoa):
+        problems.append("achieved_over_achievable is zero everywhere")
+    gaps = [h for h in snap.get("histograms", [])
+            if h.get("name") == "step_gap_s"]
+    if not any(h.get("count") for h in gaps):
+        problems.append("step_gap_s histogram empty (no consecutive decode "
+                        "chunks recorded)")
+    trace_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    trace_path = os.path.join(trace_dir, TRACE_FILENAME)
+    if not os.path.exists(trace_path):
+        problems.append(f"{trace_path} missing (run with --trace-out or "
+                        "--telemetry-dir)")
+        return problems
+    with open(trace_path, encoding="utf-8") as f:
+        trace = json.load(f)
+    problems.extend(f"trace.json: {p}" for p in validate_chrome_trace(trace))
+    cats = {ev.get("cat") for ev in trace.get("traceEvents", [])
+            if ev.get("ph") == "X"}
+    for want in ("prefill", "decode"):
+        if want not in cats:
+            problems.append(f"trace.json has no cat={want!r} spans")
+    if not any(ev.get("ph") == "b" for ev in trace.get("traceEvents", [])):
+        problems.append("trace.json has no request spans (async b/e events)")
+    return problems
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path")
@@ -162,11 +214,13 @@ def main() -> int:
     ap.add_argument("--require-breaker", action="store_true")
     ap.add_argument("--require-integrity", action="store_true")
     ap.add_argument("--require-fleet", action="store_true")
+    ap.add_argument("--require-profile", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
                  require_integrity=a.require_integrity,
-                 require_fleet=a.require_fleet)
+                 require_fleet=a.require_fleet,
+                 require_profile=a.require_profile)
 
 
 if __name__ == "__main__":
