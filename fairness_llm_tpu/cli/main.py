@@ -133,6 +133,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="with --continuous: concurrent KV slots "
                         "(= decode-step batch rows)")
+    p.add_argument("--overload", action="store_true",
+                   help="with --continuous: arm overload control "
+                        "(serving/overload.py) — QoS classes (interactive/"
+                        "batch/probe) with per-class bounded sub-queues and "
+                        "strict-priority-with-aging dequeue, deadline-"
+                        "feasibility admission (provably-doomed requests "
+                        "shed with finish_reason=shed + retry-after instead "
+                        "of burning a prefill), and an SLO-burn-driven shed "
+                        "controller walking a brownout ladder: shed batch "
+                        "-> cap batch tokens -> interactive-only. See "
+                        "docs/SERVING.md §QoS and overload control")
+    p.add_argument("--shed-burn-threshold", type=float, default=None,
+                   metavar="B",
+                   help="with --overload: fast-window SLO burn rate at "
+                        "which the shed controller escalates one brownout "
+                        "rung (default 2.0)")
+    p.add_argument("--shed-healthy-window", type=float, default=None,
+                   metavar="S",
+                   help="with --overload: seconds of sustained health "
+                        "required per de-escalation rung (hysteresis; "
+                        "default 5)")
+    p.add_argument("--batch-token-cap", type=int, default=None, metavar="T",
+                   help="with --overload: max_new_tokens clamp applied to "
+                        "batch-class requests at brownout rung 2+ "
+                        "(default 32)")
     p.add_argument("--replicas", type=int, default=None, metavar="N",
                    help="with --continuous: serve through N data-parallel "
                         "engine replicas behind a health-aware router "
@@ -316,6 +341,31 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--slots must be >= 1")
             serve_kwargs["num_slots"] = args.slots
         updates["serving"] = ServingConfig(**serve_kwargs)
+    overload_flags = (args.shed_burn_threshold, args.shed_healthy_window,
+                      args.batch_token_cap)
+    if args.overload or any(v is not None for v in overload_flags):
+        from fairness_llm_tpu.config import OverloadConfig
+
+        if not args.overload:
+            raise SystemExit("--shed-burn-threshold/--shed-healthy-window/"
+                             "--batch-token-cap require --overload")
+        if not args.continuous:
+            raise SystemExit("--overload requires --continuous (overload "
+                             "control gates the serving admission queue)")
+        ov_kwargs: Dict = {"enabled": True}
+        if args.shed_burn_threshold is not None:
+            if args.shed_burn_threshold <= 0:
+                raise SystemExit("--shed-burn-threshold must be > 0")
+            ov_kwargs["burn_threshold"] = args.shed_burn_threshold
+        if args.shed_healthy_window is not None:
+            if args.shed_healthy_window < 0:
+                raise SystemExit("--shed-healthy-window must be >= 0")
+            ov_kwargs["healthy_window_s"] = args.shed_healthy_window
+        if args.batch_token_cap is not None:
+            if args.batch_token_cap < 1:
+                raise SystemExit("--batch-token-cap must be >= 1")
+            ov_kwargs["batch_token_cap"] = args.batch_token_cap
+        updates["overload"] = OverloadConfig(**ov_kwargs)
     fleet_flags = (args.replicas, args.fence_level, args.fence_cooldown)
     if any(v is not None for v in fleet_flags):
         from fairness_llm_tpu.config import FleetConfig

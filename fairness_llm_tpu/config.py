@@ -81,6 +81,73 @@ class ServingConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class OverloadConfig:
+    """Overload-control knobs (``serving/overload.py``).
+
+    ``enabled`` switches the serving front door from one FIFO to the QoS
+    model: per-class bounded sub-queues (``interactive`` / ``batch`` /
+    ``probe``) with strict-priority-with-aging dequeue, per-class rate
+    quotas, deadline-feasibility admission (a request that provably cannot
+    meet its deadline is REJECTED with a retry-after hint instead of
+    burning a prefill and expiring later), and an SLO-driven shed
+    controller that walks a brownout ladder under sustained overload:
+
+        0 healthy -> 1 shed batch admissions -> 2 also cap batch
+        max_new_tokens -> 3 interactive-only
+
+    Escalation reads the fast-window SLO burn rates (``telemetry/slo.py``)
+    and the admission-queue depth; de-escalation requires
+    ``healthy_window_s`` of sustained health per rung (hysteresis — a
+    flapping signal cannot oscillate the ladder). With ``enabled=False``
+    (the default) the serving path is byte-identical to before.
+    """
+
+    enabled: bool = False
+    # Per-class sub-queue bounds (each also respects the overall
+    # ServingConfig.queue_capacity). Probes are synthetic health traffic;
+    # a handful queued is already a sign something is stuck.
+    interactive_capacity: int = 64
+    batch_capacity: int = 64
+    probe_capacity: int = 8
+    # Per-class admission quotas (RateLimiter.try_acquire at submit);
+    # None = no per-class quota (the shared ServingConfig quota still
+    # applies when set).
+    interactive_per_minute: Optional[int] = None
+    batch_per_minute: Optional[int] = None
+    probe_per_minute: Optional[int] = None
+    # Strict-priority dequeue, EXCEPT a lower-class request waiting this
+    # long is promoted (oldest-first among promoted) — bounded starvation
+    # for batch under a steady interactive stream. <= 0 disables aging
+    # (pure strict priority).
+    aging_s: float = 5.0
+    # Deadline-feasibility admission: reject-with-retry-after when the
+    # remaining deadline is below ``feasibility_safety`` x the estimated
+    # earliest first token (queue wait + prefill from live telemetry).
+    # The safety factor keeps the bound conservative — only provably
+    # doomed requests shed; 0 disables the check.
+    deadline_admission: bool = True
+    feasibility_safety: float = 0.5
+    # Shed-controller signals: escalate one rung per evaluation while the
+    # queue depth has reached ``queue_frac_threshold`` of capacity within
+    # the sampling window, OR — only while interactive traffic has been
+    # seen within ``interactive_presence_s`` — the fast-window burn rate
+    # (error_rate or ttft_p95) is at/over ``burn_threshold``. The presence
+    # gate is what keeps a single-tenant batch sweep (whose own deep queue
+    # legitimately burns the TTFT budget) from browning itself out when
+    # there is no interactive tenant to protect.
+    burn_threshold: float = 2.0
+    interactive_presence_s: float = 60.0
+    queue_frac_threshold: float = 0.9
+    queue_window_s: float = 2.0  # depth-sample memory (self-decaying hwm)
+    healthy_window_s: float = 5.0  # sustained health per de-escalation rung
+    eval_interval_s: float = 0.25  # min seconds between controller steps
+    # Rung 2: batch requests' max_new_tokens clamp (smaller answers under
+    # brownout beat no answers; interactive budgets are never touched).
+    batch_token_cap: int = 32
+    retry_after_s: float = 1.0  # base retry-after hint for class sheds
+
+
+@dataclasses.dataclass(frozen=True)
 class FleetConfig:
     """Data-parallel replica fleet knobs (``serving/fleet.py``).
 
@@ -321,6 +388,12 @@ class Config:
     # batch shape lose nothing, and the static path remains the reference
     # numerics). --continuous on the CLI flips enabled.
     serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    # Overload control: QoS classes + deadline-aware admission + SLO-driven
+    # load shedding (--overload; needs --continuous). Off by default — the
+    # serving path is byte-identical without it. See docs/SERVING.md §QoS.
+    overload: OverloadConfig = dataclasses.field(
+        default_factory=OverloadConfig
+    )
     # Replica fleet: data-parallel engine replicas behind a health-aware
     # router (--replicas N; needs --continuous). A sick replica is fenced
     # and drained, its requests migrate to healthy replicas, and it

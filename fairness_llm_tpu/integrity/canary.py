@@ -126,7 +126,7 @@ class CanaryProbe:
         self._seq += 1
         req = Request(
             prompt=self.prompt, id=f"__canary_{self._seq}__",
-            settings=self.settings, row_seed=0,
+            settings=self.settings, row_seed=0, qos="probe",
         )
         probe_t0 = time.monotonic()
         res = scheduler.serve([req])[0]
@@ -137,6 +137,19 @@ class CanaryProbe:
             self.labels.get("replica") or self.component,
             probe_t0, time.monotonic() - probe_t0,
         )
+        reg_sh = get_registry()
+        if res.finish_reason == "shed":
+            # Overload control refused the probe (serving/overload.py,
+            # brownout rung 3 rejects all non-interactive traffic): an
+            # INCONCLUSIVE probe, not a mismatch — tripping the breaker on
+            # a deliberate shed would turn flow control into a fault.
+            reg_sh.counter("canary_runs_total", component=self.component,
+                           **self.labels).inc()
+            emit_event("canary_shed", component=self.component,
+                       **self.labels)
+            logger.warning("canary probe shed by overload control; "
+                           "inconclusive (not counted as a mismatch)")
+            return True
         got = np.asarray(res.tokens)
         n = len(got)
         ok = bool(

@@ -387,7 +387,8 @@ def backend_for(
         return ServingBackend(engine, serving, name=model_name,
                               resilience=resilience, journal=journal,
                               integrity=integrity,
-                              fleet=getattr(config, "fleet", None))
+                              fleet=getattr(config, "fleet", None),
+                              overload=getattr(config, "overload", None))
     # Speculation rides on the backend (not the engine default) so sweeps
     # opted in via Config get it while direct engine users stay explicit.
     spec = getattr(config, "speculation", None)

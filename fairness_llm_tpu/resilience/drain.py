@@ -82,6 +82,10 @@ class ServingJournal:
             "prompt": request.prompt,
             "row_seed": request.row_seed,
             "deadline_s": request.deadline_s,
+            # QoS class survives the drain: a resumed batch request must
+            # stay batch (or it would jump the interactive sub-queue and
+            # dodge the brownout ladder in the successor process).
+            "qos": getattr(request, "qos", "interactive"),
             "settings": dataclasses.asdict(s) if s is not None else None,
             "ts_unix": time.time(),
         })
@@ -188,6 +192,9 @@ class ServingJournal:
             out.append(Request(
                 prompt=spec["prompt"], id=spec["id"], settings=settings,
                 row_seed=spec.get("row_seed"), deadline_s=deadline,
+                # Pre-QoS journals have no field; interactive is the
+                # Request default those runs were implicitly serving as.
+                qos=spec.get("qos", "interactive"),
             ))
         return out
 

@@ -97,6 +97,30 @@ def _token_lcp(rows) -> int:
     return int(mismatch[0]) if mismatch.size else limit
 
 
+def _is_kernel_compile_error(e: Exception) -> bool:
+    """Whether ``e`` is the fused decode-attention kernel failing to
+    COMPILE (the VMEM-gate miss the XLA-path fallback exists for).
+
+    Two conditions, both required: the exception must be a compile-/
+    runtime-layer error raised by jaxlib (``XlaRuntimeError`` — Mosaic
+    rejections surface through it — or any exception whose defining module
+    lives under jaxlib/mosaic), AND its text must name the VMEM/Mosaic
+    budget. The old substring-only match would also have absorbed an
+    arbitrary Python exception that merely mentioned 'scoped', silently
+    downgrading the engine for a bug that had nothing to do with the
+    kernel."""
+    mod = type(e).__module__ or ""
+    compile_layer = (
+        isinstance(e, jax.errors.JaxRuntimeError)
+        or type(e).__name__ == "XlaRuntimeError"
+        or mod.startswith(("jaxlib", "jax._src.pallas", "mosaic"))
+    )
+    if not compile_layer:
+        return False
+    msg = str(e).lower()
+    return "vmem" in msg or "mosaic" in msg or "scoped" in msg
+
+
 def _bucket_batch(n: int, mesh: Optional[jax.sharding.Mesh] = None) -> int:
     # Multiples of 8 (sublane granularity), not powers of two: decode steps
     # stream the whole [B, max_len] KV cache from HBM, so padding 45 -> 64
@@ -816,10 +840,9 @@ class DecodeEngine:
             res = call(fn)
         except Exception as e:  # noqa: BLE001 — two in-call degradations below
             degraded_in_call = True
-            msg = str(e).lower()
             if (
                 self.config.use_decode_attention_kernel
-                and ("vmem" in msg or "mosaic" in msg or "scoped" in msg)
+                and _is_kernel_compile_error(e)
             ):
                 # VMEM-gate miss fallback: the fused decode-attention
                 # kernel's eligibility gate is a calibrated VMEM model
@@ -956,6 +979,11 @@ class DecodeEngine:
             "prefix_len": prefix_len,
             # spec decode carries draft_len spare slots for the last window
             "cache_slots": prompt_len + max_new + (spec.draft_len if use_spec else 0),
+            # The EFFECTIVE attention path: read from config AFTER any
+            # in-call VMEM fallback, so a record produced past a gate miss
+            # carries decode_kernel=False provenance even though the
+            # engine was built with the kernel requested.
+            "decode_kernel": bool(self.config.use_decode_attention_kernel),
         }
         if spec_stats is not None:
             stats["speculation"] = spec_stats.as_dict()

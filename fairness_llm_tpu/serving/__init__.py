@@ -1,7 +1,9 @@
 """Continuous-batching serving subsystem.
 
-Layers (bottom-up): ``request`` (Request/Result wire format) -> ``queue``
-(bounded admission + rate limiting) -> ``slots`` (KV slot pool allocator)
+Layers (bottom-up): ``request`` (Request/Result wire format, QoS classes)
+-> ``queue`` (bounded admission + rate limiting; per-class sub-queues in
+QoS mode) -> ``overload`` (shed controller + deadline-feasibility
+admission) -> ``slots`` (KV slot pool allocator)
 -> ``scheduler`` (the prefill/decode step loop) -> ``router``/``fleet``
 (health-aware routing over N replica schedulers, per-replica fault domains
 with fence/migrate/rejoin) -> ``backend`` (the ``DecodeBackend`` adapter
@@ -10,15 +12,23 @@ the pipeline phases consume). See docs/SERVING.md.
 
 from fairness_llm_tpu.serving.backend import ServingBackend
 from fairness_llm_tpu.serving.fleet import Replica, ReplicaSet
-from fairness_llm_tpu.serving.queue import AdmissionQueue
-from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.overload import (
+    DeadlineEstimator,
+    ShedController,
+)
+from fairness_llm_tpu.serving.queue import AdmissionQueue, ClassedAdmissionQueue
+from fairness_llm_tpu.serving.request import QOS_CLASSES, Request, Result
 from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 
 __all__ = [
     "AdmissionQueue",
+    "ClassedAdmissionQueue",
     "ContinuousScheduler",
+    "DeadlineEstimator",
+    "QOS_CLASSES",
+    "ShedController",
     "HealthRouter",
     "Replica",
     "ReplicaSet",

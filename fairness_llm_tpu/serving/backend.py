@@ -46,6 +46,7 @@ from fairness_llm_tpu.config import (
     FleetConfig,
     IntegrityConfig,
     ModelSettings,
+    OverloadConfig,
     ResilienceConfig,
     ServingConfig,
 )
@@ -69,7 +70,8 @@ class ServingBackend:
                  resilience: Optional[ResilienceConfig] = None,
                  journal: Optional[ServingJournal] = None,
                  integrity: Optional[IntegrityConfig] = None,
-                 fleet: Optional[FleetConfig] = None):
+                 fleet: Optional[FleetConfig] = None,
+                 overload: Optional[OverloadConfig] = None):
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.name = name or engine.config.name
@@ -77,6 +79,13 @@ class ServingBackend:
         self.resilience = resilience
         self.journal = journal
         self.integrity = integrity
+        # Overload control (serving/overload.py): QoS classes + deadline
+        # admission + the shed controller, gated at the serving front door
+        # (the scheduler, or the ReplicaSet intake in fleet mode). This
+        # backend's sweep traffic is marked qos="batch" — exactly the
+        # class a brownout sheds first so interactive traffic survives.
+        self.overload = overload if (overload is not None
+                                     and overload.enabled) else None
         # Replica fleet (serving/fleet.py): fleet.replicas > 1 makes
         # scheduler_for build a ReplicaSet per sampler tuple instead of a
         # single scheduler — N fault domains behind the health-aware
@@ -145,6 +154,7 @@ class ServingBackend:
                 journal=self.journal, fault_injector=self.fault_injector,
                 integrity=self.integrity,
                 name=None if self._fleet_seq == 0 else f"s{self._fleet_seq}",
+                overload=self.overload,
             )
             self._fleet_seq += 1
         else:
@@ -152,7 +162,7 @@ class ServingBackend:
                 self.engine, self.serving, settings=settings,
                 fault_injector=self.fault_injector,
                 resilience=self.resilience, journal=self.journal,
-                breakers=self.board,
+                breakers=self.board, overload=self.overload,
             )
         keys = list(self._schedulers)
         while len(keys) >= 2:
@@ -286,7 +296,12 @@ class ServingBackend:
             else:
                 rid, row_seed = f"call{seed}_{i:05d}", (seed * 1_000_003 + i) & 0xFFFFFFFF
             requests.append(Request(
-                prompt=p, id=rid, settings=settings, row_seed=row_seed
+                prompt=p, id=rid, settings=settings, row_seed=row_seed,
+                # Phase sweeps are throughput traffic: the class a
+                # brownout sheds first (shed rows return None below — the
+                # resumable-sentinel convention, so a shed sweep row is
+                # retried by the pipeline's containment, not lost).
+                qos="batch",
             ))
         results = sched.serve(requests)
         stats = sched.last_stats
@@ -309,6 +324,9 @@ class ServingBackend:
                 "prompt_len": sched.max_prompt_bucket,
                 "prefix_len": 0,
                 "cache_slots": sched.cache_len,
+                "decode_kernel": bool(
+                    self.engine.config.use_decode_attention_kernel
+                ),
                 "serving": stats.as_dict() if stats is not None else None,
             },
         )

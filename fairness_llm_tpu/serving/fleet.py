@@ -68,12 +68,18 @@ from fairness_llm_tpu.config import (
     FleetConfig,
     IntegrityConfig,
     ModelSettings,
+    OverloadConfig,
     ResilienceConfig,
     ServingConfig,
 )
 from fairness_llm_tpu.resilience.drain import ServingJournal, drain_requested
-from fairness_llm_tpu.serving.queue import AdmissionQueue
-from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.overload import (
+    DeadlineEstimator,
+    ShedController,
+    count_shed,
+)
+from fairness_llm_tpu.serving.queue import AdmissionQueue, ClassedAdmissionQueue
+from fairness_llm_tpu.serving.request import QOS_CLASSES, QOS_PRIORITY, Request, Result
 from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.telemetry import emit_event, get_registry
@@ -89,6 +95,26 @@ logger = logging.getLogger(__name__)
 # rejoin must pass through their half-open machinery, not just the fleet
 # cooldown timer.
 CRASH_CLASS_REASONS = ("replica_crash", "replica_hang", "stalled")
+
+
+class _FleetDeadlineEstimator(DeadlineEstimator):
+    """Fleet-wide feasibility: the per-replica schedulers' histograms are
+    labeled ``{"replica": name}``, so the fleet's lower bound reads the
+    FASTEST replica's p50s (min across replicas — optimistic, which is
+    exactly what a provable lower bound needs)."""
+
+    def __init__(self, replicas, safety: float = 0.5):
+        super().__init__(safety=safety)
+        self._replicas = replicas
+
+    def _p50(self, name: str):
+        vals = []
+        for rep in self._replicas:
+            h = get_registry().peek(name, component="serving",
+                                    replica=rep.name)
+            if h is not None and getattr(h, "count", 0):
+                vals.append(h.percentile(50))
+        return min(vals) if vals else None
 
 
 class Replica:
@@ -136,6 +162,7 @@ class ReplicaSet:
         fault_injector=None,
         integrity: Optional[IntegrityConfig] = None,
         name: Optional[str] = None,
+        overload: Optional[OverloadConfig] = None,
     ):
         # ``name`` namespaces this fleet's instruments when a process runs
         # MORE THAN ONE ReplicaSet (ServingBackend keeps one per sampler
@@ -183,15 +210,42 @@ class ReplicaSet:
                 journal=journal, replica=rep_name,
             )
             self.replicas.append(Replica(rep_name, eng, sched))
+        # Overload control (serving/overload.py): the fleet intake is the
+        # front door in fleet mode, so the gate lives HERE — replica
+        # schedulers stay plain (gating again after routing would
+        # double-shed a request the fleet already accepted). The
+        # controller's burn signal aggregates per-replica gauges; the
+        # feasibility bound reads the fastest replica's p50s.
+        self.overload = overload if (overload is not None
+                                     and overload.enabled) else None
+        if self.overload is not None:
+            self.shed_controller: Optional[ShedController] = ShedController(
+                self.overload, labels=self._fleet_labels,
+                burn_fn=self._max_replica_burn,
+            )
+            self.deadline_estimator: Optional[DeadlineEstimator] = (
+                _FleetDeadlineEstimator(
+                    self.replicas, safety=self.overload.feasibility_safety,
+                ) if self.overload.deadline_admission else None
+            )
+        else:
+            self.shed_controller = None
+            self.deadline_estimator = None
+        self._shed_fleet = 0  # fleet-level sheds since the last stats close
         # The fleet's own bounded admission queue — the backpressure
         # boundary callers see; the router feeds replica queues from it.
-        self.queue = AdmissionQueue(
-            capacity=self.serving.queue_capacity,
-            rate_limiter=(
-                RateLimiter(self.serving.admission_per_minute)
-                if self.serving.admission_per_minute else None
-            ),
-        )
+        fleet_rate = (RateLimiter(self.serving.admission_per_minute)
+                      if self.serving.admission_per_minute else None)
+        if self.overload is not None:
+            self.queue: AdmissionQueue = ClassedAdmissionQueue(
+                capacity=self.serving.queue_capacity,
+                rate_limiter=fleet_rate, overload=self.overload,
+            )
+        else:
+            self.queue = AdmissionQueue(
+                capacity=self.serving.queue_capacity,
+                rate_limiter=fleet_rate,
+            )
         self._pending: Deque[Request] = deque()
         self._migrating: Deque[Request] = deque()
         self._results: Dict[str, Result] = {}
@@ -231,6 +285,90 @@ class ReplicaSet:
     def healthy_count(self) -> int:
         return sum(1 for r in self.replicas if not r.fenced)
 
+    # -- overload gate (serving/overload.py) ---------------------------------
+
+    def _max_replica_burn(self) -> float:
+        """The fleet controller's burn signal: the hottest fast-window
+        burn across every replica's own SLO gauges."""
+        reg = get_registry()
+        return max(
+            (reg.read_value("slo_burn_rate", default=0.0,
+                            component="serving", replica=rep.name,
+                            slo=slo, window="fast")
+             for rep in self.replicas
+             for slo in ("error_rate", "ttft_p95")),
+            default=0.0,
+        )
+
+    def _queued_ahead(self, qos: str) -> int:
+        """Same-or-higher-priority work still fleet-held (queued or
+        pending) — the feasibility bound's wave count."""
+        if isinstance(self.queue, ClassedAdmissionQueue):
+            ahead = sum(
+                d for c, d in self.queue.class_depths().items()
+                if QOS_PRIORITY[c] <= QOS_PRIORITY[qos]
+            )
+        else:
+            ahead = len(self.queue)
+        return ahead + sum(
+            1 for r in self._pending
+            if QOS_PRIORITY[r.qos] <= QOS_PRIORITY[qos]
+        )
+
+    def _deliver_shed(self, req: Request, reason: str, error: str,
+                      retry_after: float, journaled: bool) -> None:
+        count_shed(req.qos, reason, labels=self._fleet_labels)
+        # Outcome counter parity with the scheduler front door: a
+        # fleet-intake shed never reached a replica's tracer (no span
+        # lane), but dashboards summing requests_finished_total across
+        # components must still see it as a terminal outcome.
+        get_registry().counter(
+            "requests_finished_total", component="fleet", outcome="shed",
+            **self._fleet_labels,
+        ).inc()
+        self._shed_fleet += 1
+        if journaled and self.journal is not None:
+            self.journal.record_terminal(req.id, "shed")
+        self._deliver(req.id, Result(
+            id=req.id, ok=False, finish_reason="shed", error=error,
+            retries=req.retries,
+            latency_s=time.monotonic() - req.submitted_at,
+            retry_after_s=retry_after,
+        ))
+
+    def _overload_gate(self, req: Request, journaled: bool = True) -> bool:
+        """True when the fleet terminally shed ``req`` (Result delivered
+        with a retry-after). Mirrors the scheduler's gate — brownout class
+        admission, then deadline feasibility — at the fleet's front door."""
+        ctl = self.shed_controller
+        if ctl is None:
+            return False
+        if req.qos == "interactive":
+            ctl.note_interactive()
+        if not ctl.admits(req.qos):
+            self._deliver_shed(
+                req, "overload",
+                f"overload level {ctl.level} ({ctl.rung}) sheds "
+                f"{req.qos}-class admissions; retry after "
+                f"{ctl.retry_after()}s",
+                ctl.retry_after(), journaled,
+            )
+            return True
+        if self.deadline_estimator is not None and req.deadline_s is not None:
+            est = self.deadline_estimator.infeasible(
+                req, self._queued_ahead(req.qos), self.num_slots,
+                self.replicas[0].sched.decode_chunk,
+            )
+            if est is not None:
+                self._deliver_shed(
+                    req, "deadline_infeasible",
+                    "deadline provably unmeetable at fleet intake "
+                    f"(estimated earliest first token {est:.3f}s)",
+                    ctl.retry_after(est), journaled,
+                )
+                return True
+        return False
+
     # -- serve ---------------------------------------------------------------
 
     def serve(self, requests: Sequence[Request]) -> List[Result]:
@@ -250,6 +388,11 @@ class ReplicaSet:
             self.replicas[0].sched._check_settings(req)
         for req in requests:
             req.submitted_at = now
+            # Overload gate before acceptance (never journaled — a shed
+            # request was refused, not accepted): the Result is already
+            # delivered, so the serve loop below sees it as terminal.
+            if self._overload_gate(req, journaled=False):
+                continue
             if self.journal is not None:
                 # Fleet-level intake ledger: a request preempted while
                 # still fleet-held (never reached a replica scheduler)
@@ -295,6 +438,15 @@ class ReplicaSet:
     # -- the fleet loop ------------------------------------------------------
 
     def _tick(self) -> bool:
+        if self.shed_controller is not None:
+            # One depth sample (fleet-held work vs fleet capacity) + a
+            # throttled ladder step per tick — the fleet-mode twin of the
+            # scheduler loop's controller tick.
+            self.shed_controller.observe_queue_depth(
+                len(self.queue) + len(self._pending),
+                self.serving.queue_capacity,
+            )
+            self.shed_controller.maybe_evaluate()
         progressed = self._expire_held()
         progressed |= self._route()
         for rep in self.replicas:
@@ -355,17 +507,44 @@ class ReplicaSet:
         requests (front of line — they were admitted once already) and
         queued admissions on the healthiest replicas."""
         moved = False
-        while self._pending and not self.queue.full:
-            if not self.queue.submit(self._pending[0],
-                                     count_rejection=False):
-                break  # rate-limited; retry next tick
-            self._pending.popleft()
-            moved = True
+        if self.shed_controller is None:
+            while self._pending and not self.queue.full:
+                if not self.queue.submit(self._pending[0],
+                                         count_rejection=False):
+                    break  # rate-limited; retry next tick
+                self._pending.popleft()
+                moved = True
+        else:
+            # QoS mode: re-gate pending at each feed (the ladder may have
+            # climbed since intake), and never let one bounded class
+            # head-of-line-block the others — same one-pass class-skip
+            # scan as the scheduler's _feed.
+            blocked: set = set()
+            kept: Deque[Request] = deque()
+            while self._pending:
+                if len(blocked) == len(QOS_CLASSES):
+                    kept.extend(self._pending)
+                    self._pending.clear()
+                    break
+                req = self._pending.popleft()
+                if req.qos in blocked:
+                    kept.append(req)
+                    continue
+                if self._overload_gate(req):  # journaled at intake
+                    moved = True
+                    continue
+                if not self.queue.submit(req, count_rejection=False):
+                    blocked.add(req.qos)
+                    kept.append(req)
+                else:
+                    moved = True
+            self._pending = kept
         while self._migrating:
-            rep = self.router.pick(self.replicas)
+            req = self._migrating[0]
+            rep = self.router.pick(self.replicas, qos=req.qos)
             if rep is None:
                 break
-            req = self._migrating.popleft()
+            self._migrating.popleft()
             # front=True: a migrated request already waited through its
             # fenced replica's queue — on the new replica it goes ahead of
             # work that hasn't, which is also what bounds failover
@@ -380,10 +559,15 @@ class ReplicaSet:
             rep.assigned[req.id] = req
             moved = True
         while len(self.queue):
-            rep = self.router.pick(self.replicas)
-            if rep is None:
-                break
             req = self.queue.pop(1)[0]
+            # qos-aware placement (serving/router.py): non-interactive
+            # traffic prefers replicas not burning their fast-window SLO
+            # budgets, so bulk load steers away from replicas already
+            # failing their users.
+            rep = self.router.pick(self.replicas, qos=req.qos)
+            if rep is None:
+                self.queue.requeue(req)
+                break
             if not rep.sched.submit(req, restamp=False):
                 self.queue.requeue(req)
                 break
@@ -594,6 +778,7 @@ class ReplicaSet:
                     self.settings.max_tokens, self.integrity.canary_max_tokens
                 )),
                 row_seed=0,
+                qos="probe",
             )
             res = rep.sched.serve([smoke])[0]
             get_registry().counter(
@@ -694,4 +879,6 @@ class ReplicaSet:
         agg.num_slots = self.num_slots
         agg.rejected += self.queue.rejected - self._rejected_taken
         self._rejected_taken = self.queue.rejected
+        agg.shed += self._shed_fleet
+        self._shed_fleet = 0
         self.last_stats = agg

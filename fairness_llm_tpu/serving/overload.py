@@ -1,0 +1,348 @@
+"""Overload control: QoS classes, deadline-aware admission, SLO-driven
+load shedding.
+
+The stack below this module survives *faults* — watchdog, breakers,
+fence/migrate/rejoin — but not *overload*: the admission queue was one FIFO
+with one rate limiter, every request was equal, and a doomed request (a
+deadline unmeetable at admission time) still burned a full prefill before
+expiring. At production scale the paper's own workload makes that acute:
+the phase-1/3 counterfactual sweeps are batch floods that would starve
+interactive recommendation traffic, and PR 7's SLO burn rates could *see*
+the starvation but nothing *acted* on it beyond a router discount. This
+module is the acting half:
+
+- **QoS classes** (``Request.qos``: ``interactive`` / ``batch`` /
+  ``probe``): the admission queue becomes per-class bounded sub-queues
+  (``ClassedAdmissionQueue``, serving/queue.py) with per-class rate
+  quotas and strict-priority-with-aging dequeue — a batch flood can never
+  delay an interactive admission by more than the chunk in flight, while
+  aging bounds batch starvation under a steady interactive stream.
+- **Deadline-feasibility admission** (``DeadlineEstimator``): from live
+  telemetry (the ``prefill_wall_s`` and ``per_output_token_s`` histograms
+  this scheduler already feeds), lower-bound the earliest possible first
+  token — queue turnover waves + one prefill + one decode step — and
+  REJECT with ``finish_reason="shed"`` + a retry-after hint any request
+  whose remaining deadline is provably below it. The bound is
+  deliberately optimistic (p50 estimates, a ``feasibility_safety``
+  discount, cold start never rejects): only certainly-doomed work sheds;
+  everything marginal is admitted and judged by the real clock.
+- **SLO-driven shedding** (``ShedController``): a brownout ladder driven
+  by the fast-window burn rates (``telemetry/slo.py``) and the admission
+  queue depth, with hysteresis:
+
+      0 healthy
+      1 shed_batch          — reject new batch admissions (retry-after)
+      2 cap_batch_tokens    — also clamp batch max_new_tokens
+      3 interactive_only    — reject everything non-interactive
+
+  Escalation moves at most ONE rung per evaluation while any signal is
+  hot; de-escalation requires ``healthy_window_s`` of sustained health
+  per rung (a flapping signal ratchets up but cannot oscillate). Every
+  transition is exported: the ``overload_level`` gauge,
+  ``overload_transitions_total{to}`` counters, ``overload_shed`` /
+  ``overload_restore`` JSONL events, and shed/restore instants on the
+  scheduler's timeline track. Sheds count ``shed_total{class,reason}``.
+
+Placement: the gate lives at the serving FRONT DOOR — the
+``ContinuousScheduler`` when it is the front door (single-engine mode),
+the ``ReplicaSet`` intake in fleet mode (replica schedulers stay plain:
+gating per-replica after fleet routing would double-shed). Shed requests
+are excluded from SLO burn math (like ``preempted``): deliberate load
+shedding is flow control the controller itself reports via ``shed_total``
+— feeding it back into the error burn would lock the ladder at its top
+rung. See docs/SERVING.md §QoS and overload control.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+from fairness_llm_tpu.config import OverloadConfig
+from fairness_llm_tpu.serving.request import QOS_CLASSES, QOS_PRIORITY, Request
+from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.timeline import get_timeline
+
+logger = logging.getLogger(__name__)
+
+# Brownout rungs, in escalation order. Rung semantics live in admits() /
+# batch_cap(); the names label events, logs, and the gauge description.
+SHED_LADDER = ("healthy", "shed_batch", "cap_batch_tokens",
+               "interactive_only")
+
+
+def count_shed(qos: str, reason: str, component: str = "serving",
+               labels: Optional[Dict[str, str]] = None) -> None:
+    """One shed, attributed: ``shed_total{class, reason}``. Reasons:
+    ``overload`` (class refused at the current brownout rung),
+    ``deadline_infeasible`` (feasibility admission), ``queue_full`` /
+    ``rate_limit`` (per-class bounds, when the caller terminates rather
+    than backpressures)."""
+    get_registry().counter(
+        "shed_total", component=component,
+        **{"class": qos, "reason": reason, **(labels or {})},
+    ).inc()
+
+
+class DeadlineEstimator:
+    """Feasibility math for admission, from live telemetry.
+
+    The earliest possible first token for a request with ``queued_ahead``
+    same-or-higher-priority requests in front of it is lower-bounded by
+
+        waves x chunk_wall + prefill + one_decode_step
+
+    where ``waves = queued_ahead // num_slots`` (each turnover of the slot
+    pool frees at most ``num_slots`` seats and takes at least one compiled
+    decode chunk) and the walls come from this scheduler's own histograms
+    — ``prefill_wall_s`` p50 and ``per_output_token_s`` p50 (the steady
+    decode cadence). Every term is an under-estimate on purpose: admitted
+    rows usually hold their slots far longer than one chunk, so a request
+    failing even this bound is *provably* doomed. ``safety`` discounts the
+    bound further before it can reject. With no telemetry yet (cold
+    start), ``estimate_ttft_s`` returns None and nothing is ever shed.
+    """
+
+    def __init__(self, safety: float = 0.5, component: str = "serving",
+                 labels: Optional[Dict[str, str]] = None,
+                 clock=time.monotonic):
+        self.safety = float(safety)
+        self.component = component
+        self.labels = dict(labels or {})
+        self._clock = clock
+
+    def _p50(self, name: str) -> Optional[float]:
+        h = get_registry().peek(name, component=self.component,
+                                **self.labels)
+        if h is None or not getattr(h, "count", 0):
+            return None
+        return h.percentile(50)
+
+    def estimate_ttft_s(self, queued_ahead: int, num_slots: int,
+                        decode_chunk: int) -> Optional[float]:
+        """Lower-bound seconds to the request's first token, or None when
+        there is no telemetry to bound with."""
+        prefill = self._p50("prefill_wall_s")
+        per_tok = self._p50("per_output_token_s")
+        if prefill is None and per_tok is None:
+            return None
+        waves = max(0, int(queued_ahead)) // max(int(num_slots), 1)
+        chunk_s = (per_tok or 0.0) * max(int(decode_chunk), 1)
+        return waves * chunk_s + (prefill or 0.0) + (per_tok or 0.0)
+
+    def infeasible(self, request: Request, queued_ahead: int,
+                   num_slots: int, decode_chunk: int,
+                   now: Optional[float] = None) -> Optional[float]:
+        """None when the request might make its deadline (or has none, or
+        safety is 0, or telemetry is cold); otherwise the estimated
+        earliest-TTFT in seconds — the retry-after hint's basis. A
+        deadline already in the past is infeasible by definition."""
+        if request.deadline_s is None or self.safety <= 0.0:
+            return None
+        t = self._clock() if now is None else now
+        remaining = request.submitted_at + request.deadline_s - t
+        est = self.estimate_ttft_s(queued_ahead, num_slots, decode_chunk)
+        if remaining <= 0.0:
+            return est if est is not None else 0.0
+        if est is not None and remaining < self.safety * est:
+            return est
+        return None
+
+
+class ShedController:
+    """The brownout ladder: one level in [0, 3], walked up under sustained
+    overload signals and back down only after a sustained-healthy window
+    per rung. One controller per serving front door (scheduler or fleet),
+    labeled like its other instruments."""
+
+    def __init__(self, config: Optional[OverloadConfig] = None,
+                 component: str = "serving",
+                 labels: Optional[Dict[str, str]] = None,
+                 clock=time.monotonic, burn_fn=None):
+        self.config = config or OverloadConfig(enabled=True)
+        self.component = component
+        self.labels = dict(labels or {})
+        self._clock = clock
+        # Custom burn reader: the fleet's controller aggregates PER-REPLICA
+        # burn gauges (its own label set has none); None = read this
+        # controller's own labeled gauges.
+        self._burn_fn = burn_fn
+        # Burn-driven escalation is gated on recent INTERACTIVE presence
+        # (note_interactive below): the burn signal exists to protect
+        # latency-sensitive users, and in a single-tenant batch run — the
+        # CPU-harness study sweep — a deep queue of the user's OWN batch
+        # work legitimately burns the TTFT budget, where shedding/capping
+        # batch would brown out the only tenant to protect nobody. The
+        # depth signal guards the queue itself in both regimes.
+        self._last_interactive: Optional[float] = None
+        self.level = 0
+        self._healthy_since: Optional[float] = None
+        self._last_eval: Optional[float] = None
+        # (t, depth) samples — a self-decaying high-water mark over
+        # queue_window_s, fed by the scheduler loop. Unlike the
+        # queue_depth_hwm gauge (which resets per drain), this window ages
+        # out on its own, so de-escalation works mid-serve.
+        self._depth: Deque[Tuple[float, float]] = deque()
+        self._depth_capacity = 1.0
+        # Gauge exists from construction: a healthy snapshot still shows
+        # the controller was armed (level 0).
+        self._gauge().set(0)
+
+    # -- instruments --------------------------------------------------------
+
+    def _gauge(self):
+        return get_registry().gauge("overload_level",
+                                    component=self.component, **self.labels)
+
+    @property
+    def rung(self) -> str:
+        return SHED_LADDER[self.level]
+
+    # -- gating -------------------------------------------------------------
+
+    def admits(self, qos: str) -> bool:
+        """Whether the current rung admits this class. Rungs 1-2 shed
+        ``batch``; rung 3 admits only ``interactive``. Probes survive to
+        rung 3 despite their bottom dequeue priority — blinding the canary
+        while the stack is sick would cost more than a probe's decode."""
+        if self.level <= 0:
+            return True
+        if self.level >= 3:
+            return qos == "interactive"
+        return qos != "batch"
+
+    def batch_cap(self, cap: int, qos: str) -> int:
+        """Rung >= 2: clamp a batch request's decode budget to
+        ``batch_token_cap`` (brownout: shorter answers beat no answers).
+        Interactive and probe budgets are never touched."""
+        if self.level >= 2 and qos == "batch":
+            return max(1, min(cap, self.config.batch_token_cap))
+        return cap
+
+    def retry_after(self, est_ttft: Optional[float] = None) -> float:
+        """The retry-after hint for a shed: the configured base, scaled by
+        the current rung (a deeper brownout clears slower), or the
+        feasibility estimate when that is what refused the request."""
+        base = self.config.retry_after_s * max(1, self.level)
+        if est_ttft is not None:
+            base = max(base, est_ttft)
+        return round(base, 3)
+
+    # -- signals + evaluation -----------------------------------------------
+
+    def observe_queue_depth(self, depth: int, capacity: int) -> None:
+        """One depth sample from the serving loop (window-pruned here so
+        the windowed max decays during quiet stretches)."""
+        now = self._clock()
+        self._depth.append((now, float(depth)))
+        self._depth_capacity = float(max(capacity, 1))
+        cutoff = now - self.config.queue_window_s
+        while self._depth and self._depth[0][0] < cutoff:
+            self._depth.popleft()
+
+    def _depth_frac(self, now: float) -> float:
+        cutoff = now - self.config.queue_window_s
+        vals = [d for t, d in self._depth if t >= cutoff]
+        return (max(vals) / self._depth_capacity) if vals else 0.0
+
+    def _burn(self) -> float:
+        """The hottest fast-window burn among the SLOs a brownout can
+        relieve (error rate and TTFT — e2e recovers with them)."""
+        if self._burn_fn is not None:
+            return float(self._burn_fn())
+        reg = get_registry()
+        return max(
+            reg.read_value("slo_burn_rate", default=0.0,
+                           component=self.component, slo=slo, window="fast",
+                           **self.labels)
+            for slo in ("error_rate", "ttft_p95")
+        )
+
+    def note_interactive(self, now: Optional[float] = None) -> None:
+        """One interactive-class submission seen at the front door — arms
+        the burn signal for ``interactive_presence_s``."""
+        self._last_interactive = self._clock() if now is None else now
+
+    def interactive_present(self, now: float) -> bool:
+        return self._last_interactive is not None and \
+            now - self._last_interactive <= self.config.interactive_presence_s
+
+    def overloaded(self, now: Optional[float] = None) -> Optional[str]:
+        """The hot signal's name, or None when everything is healthy.
+        Queue depth always counts; SLO burn counts only while interactive
+        traffic is present (see __init__ on why)."""
+        t = self._clock() if now is None else now
+        frac = self._depth_frac(t)
+        if frac >= self.config.queue_frac_threshold:
+            return f"queue_depth {frac:.2f}x capacity"
+        if self.interactive_present(t):
+            burn = self._burn()
+            if burn >= self.config.burn_threshold:
+                return f"slo_burn {burn:.2f}"
+        return None
+
+    def maybe_evaluate(self) -> int:
+        """Throttled ``evaluate`` for the serving loop (one controller step
+        per ``eval_interval_s`` at most, so escalation takes at least
+        3 x interval to reach the top rung — monotone, never a jump)."""
+        now = self._clock()
+        if self._last_eval is not None and \
+                now - self._last_eval < self.config.eval_interval_s:
+            return self.level
+        return self.evaluate(now=now)
+
+    def evaluate(self, now: Optional[float] = None) -> int:
+        """One controller step: at most one rung up (a signal is hot) or
+        one rung down (healthy for ``healthy_window_s``, hysteresis
+        restarting per rung). Returns the level after the step."""
+        t = self._clock() if now is None else now
+        self._last_eval = t
+        reason = self.overloaded(now=t)
+        if reason is not None:
+            self._healthy_since = None
+            if self.level < len(SHED_LADDER) - 1:
+                self._transition(self.level + 1, reason, t)
+        else:
+            if self._healthy_since is None:
+                self._healthy_since = t
+            elif self.level > 0 and \
+                    t - self._healthy_since >= self.config.healthy_window_s:
+                # Restart the healthy clock per rung: each step down needs
+                # its own sustained-healthy window (the hysteresis that
+                # stops a marginal signal from sawtoothing the ladder).
+                self._healthy_since = t
+                self._transition(self.level - 1, "sustained_healthy", t)
+        self._gauge().set(self.level)
+        return self.level
+
+    def _transition(self, to: int, reason: str, now: float) -> None:
+        frm, self.level = self.level, to
+        escalating = to > frm
+        self._gauge().set(to)
+        get_registry().counter(
+            "overload_transitions_total", component=self.component,
+            to=str(to), **self.labels,
+        ).inc()
+        event = "overload_shed" if escalating else "overload_restore"
+        emit_event(event, level=to, rung=SHED_LADDER[to], reason=reason,
+                   component=self.component, **self.labels)
+        get_timeline().record_instant(
+            "shed" if escalating else "restore",
+            self.labels.get("replica") or self.component,
+            t=now, cat="overload", level=to, reason=reason,
+        )
+        log = logger.warning if escalating else logger.info
+        log("overload level %d -> %d (%s): %s", frm, to, SHED_LADDER[to],
+            reason)
+
+
+__all__ = [
+    "DeadlineEstimator",
+    "QOS_CLASSES",
+    "QOS_PRIORITY",
+    "SHED_LADDER",
+    "ShedController",
+    "count_shed",
+]

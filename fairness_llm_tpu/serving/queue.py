@@ -12,14 +12,22 @@ Single-threaded by design: the scheduler loop is the only consumer, so this
 is a deque with explicit capacity, not a synchronized queue. Requeued
 requests (fault containment) re-enter at the FRONT so a retry doesn't go to
 the back of a long line it already waited through.
+
+``ClassedAdmissionQueue`` is the QoS variant (``serving/overload.py``,
+armed by ``OverloadConfig.enabled``): per-class bounded sub-queues with
+per-class rate quotas and strict-priority-with-aging dequeue, behind the
+same API — callers that never set ``Request.qos`` see FIFO behavior
+identical to the base queue (everything lands in one class).
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, Dict, List, Optional
 
-from fairness_llm_tpu.serving.request import Request
+from fairness_llm_tpu.config import OverloadConfig
+from fairness_llm_tpu.serving.request import QOS_CLASSES, Request
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
 
 
@@ -46,7 +54,10 @@ class AdmissionQueue:
 
     @property
     def full(self) -> bool:
-        return len(self._q) >= self.capacity
+        # len(self), not len(self._q): the classed subclass stores rows in
+        # per-class deques and overrides __len__ — overall capacity must
+        # bound the SUM.
+        return len(self) >= self.capacity
 
     def submit(self, request: Request, count_rejection: bool = True,
                front: bool = False) -> bool:
@@ -109,4 +120,143 @@ class AdmissionQueue:
         for r in self._q:
             (expired if r.expired(now) else keep).append(r)
         self._q = keep
+        return expired
+
+
+class ClassedAdmissionQueue(AdmissionQueue):
+    """Per-QoS-class admission: one bounded sub-queue per class
+    (``interactive`` / ``batch`` / ``probe``), per-class rate quotas, and
+    strict-priority-with-aging dequeue.
+
+    Isolation: each class has its own capacity bound (on top of the
+    overall ``capacity``), so a batch flood fills the batch sub-queue and
+    backpressures batch submitters while interactive admissions keep
+    flowing. ``pop`` serves the highest-priority non-empty class — EXCEPT
+    that a lower-class head waiting at least ``aging_s`` is promoted and
+    competes oldest-first (bounded starvation: a steady interactive stream
+    delays batch by at most ``aging_s``, never forever).
+
+    The base API is preserved: ``submit``/``pop``/``requeue``/
+    ``drain_expired``/``close``/``reopen``/``len``/``full`` all behave as
+    the scheduler expects; ``full`` keeps its overall-capacity meaning
+    (per-class refusals surface as ``submit() == False`` with the class's
+    sub-queue at bound). ``requeue`` front-inserts into the request's OWN
+    class — a fault-requeued batch request cannot jump the interactive
+    line just by having faulted.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 128,
+        rate_limiter: Optional[RateLimiter] = None,
+        overload: Optional[OverloadConfig] = None,
+        clock=time.monotonic,
+    ):
+        super().__init__(capacity=capacity, rate_limiter=rate_limiter)
+        self.overload = overload or OverloadConfig(enabled=True)
+        self._clock = clock
+        self._classes: Dict[str, Deque[Request]] = {
+            c: deque() for c in QOS_CLASSES
+        }
+        o = self.overload
+        self._class_caps = {
+            "interactive": o.interactive_capacity,
+            "batch": o.batch_capacity,
+            "probe": o.probe_capacity,
+        }
+        self._class_limiters: Dict[str, Optional[RateLimiter]] = {
+            "interactive": RateLimiter(o.interactive_per_minute)
+            if o.interactive_per_minute else None,
+            "batch": RateLimiter(o.batch_per_minute)
+            if o.batch_per_minute else None,
+            "probe": RateLimiter(o.probe_per_minute)
+            if o.probe_per_minute else None,
+        }
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._classes.values())
+
+    def class_depths(self) -> Dict[str, int]:
+        """Current depth per class (telemetry / tests)."""
+        return {c: len(q) for c, q in self._classes.items()}
+
+    def class_full(self, qos: str) -> bool:
+        return len(self._classes[qos]) >= self._class_caps[qos]
+
+    def submit(self, request: Request, count_rejection: bool = True,
+               front: bool = False) -> bool:
+        """Base-queue semantics plus the class bound and the class quota:
+        False = backpressure, nothing enqueued. The shared rate limiter
+        (when configured) still applies after the class's own — one global
+        quota over all classes, per-class quotas within it."""
+        qos = request.qos
+        if self.closed or self.full or self.class_full(qos):
+            if count_rejection:
+                self.rejected += 1
+            return False
+        # BOTH quotas peek-checked before EITHER consumes: acquiring the
+        # class token and then failing the shared check (or vice versa)
+        # would burn quota on a submission that was never admitted —
+        # under-admitting that class for the rest of its window.
+        limiter = self._class_limiters[qos]
+        if (limiter is not None and not limiter.can_acquire()) or (
+            self.rate_limiter is not None
+            and not self.rate_limiter.can_acquire()
+        ):
+            if count_rejection:
+                self.rejected += 1
+            return False
+        if limiter is not None:
+            limiter.try_acquire()
+        if self.rate_limiter is not None:
+            self.rate_limiter.try_acquire()
+        if front:
+            self._classes[qos].appendleft(request)
+        else:
+            self._classes[qos].append(request)
+        return True
+
+    def requeue(self, request: Request) -> None:
+        """Front-of-line within the request's own class, bypassing bounds
+        (same already-admitted rationale as the base queue)."""
+        self._classes[request.qos].appendleft(request)
+
+    def _pop_one(self, now: float) -> Optional[Request]:
+        aging = self.overload.aging_s
+        if aging > 0:
+            # Promoted heads: anything that has waited >= aging_s competes
+            # on age alone (oldest first; class rank breaks exact ties).
+            aged = [
+                (q[0].submitted_at, rank, c)
+                for rank, c in enumerate(QOS_CLASSES)
+                for q in (self._classes[c],)
+                if q and now - q[0].submitted_at >= aging
+            ]
+            if aged:
+                _, _, cls = min(aged)
+                return self._classes[cls].popleft()
+        for c in QOS_CLASSES:  # strict priority order
+            if self._classes[c]:
+                return self._classes[c].popleft()
+        return None
+
+    def pop(self, n: int = 1) -> List[Request]:
+        """Dequeue up to ``n`` requests: strict class priority, with aged
+        lower-class heads promoted (see class docstring)."""
+        now = self._clock()
+        out: List[Request] = []
+        while len(out) < n:
+            req = self._pop_one(now)
+            if req is None:
+                break
+            out.append(req)
+        return out
+
+    def drain_expired(self, now: Optional[float] = None) -> List[Request]:
+        expired: List[Request] = []
+        for c, q in self._classes.items():
+            keep: Deque[Request] = deque()
+            for r in q:
+                (expired if r.expired(now) else keep).append(r)
+            self._classes[c] = keep
         return expired

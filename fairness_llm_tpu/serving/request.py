@@ -19,6 +19,15 @@ import numpy as np
 
 from fairness_llm_tpu.config import ModelSettings
 
+# QoS classes, highest priority first (serving/overload.py). "interactive"
+# is user-facing traffic with latency SLOs; "batch" is throughput traffic
+# (the phase-1/3 counterfactual sweeps); "probe" is synthetic health
+# traffic (canary / rejoin probes) — lowest dequeue priority, but shed
+# only at the top brownout rung because blinding the canary while the
+# stack is sick is self-defeating.
+QOS_CLASSES = ("interactive", "batch", "probe")
+QOS_PRIORITY = {name: rank for rank, name in enumerate(QOS_CLASSES)}
+
 _ids = itertools.count()
 
 
@@ -50,6 +59,13 @@ class Request:
     deadlines and reported latencies never include time before the server
     saw the request. A fault requeue keeps the original stamp — retry time
     counts against the deadline and shows in the latency.
+
+    ``qos`` is the request's priority class (``QOS_CLASSES``). It only
+    matters when overload control is armed (``OverloadConfig.enabled``):
+    the admission queue then keeps per-class sub-queues with
+    strict-priority-with-aging dequeue, and the shed controller's brownout
+    ladder rejects lower classes first. Without overload control every
+    class is served FIFO exactly as before.
     """
 
     prompt: str
@@ -59,6 +75,13 @@ class Request:
     deadline_s: Optional[float] = None
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     retries: int = 0  # scheduler-owned: requeue count after faults
+    qos: str = "interactive"
+
+    def __post_init__(self) -> None:
+        if self.qos not in QOS_CLASSES:
+            raise ValueError(
+                f"request {self.id!r}: qos {self.qos!r} not in {QOS_CLASSES}"
+            )
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline_s is None:
@@ -74,9 +97,12 @@ class Result:
     ``tokens`` matches the engine's per-row convention: generated ids
     including the EOS that stopped the row (when one did), nothing after.
     ``finish_reason``: "eos" | "length" | "failed" | "deadline" |
-    "preempted" ("preempted" = a graceful drain journaled the request for
-    ``resume-serving`` instead of finishing it — terminal for THIS process
-    only; see resilience/drain.py).
+    "preempted" | "shed" ("preempted" = a graceful drain journaled the
+    request for ``resume-serving`` instead of finishing it — terminal for
+    THIS process only, see resilience/drain.py; "shed" = overload control
+    refused the request with an explicit retry-after — ``retry_after_s``
+    below is the earliest the client should resubmit, see
+    serving/overload.py).
 
     ``queue_wait_s`` / ``ttft_s`` come from the request's lifecycle spans
     (``telemetry/tracing.py``): admission wait and time-to-first-token, both
@@ -98,3 +124,7 @@ class Result:
     retries: int = 0
     queue_wait_s: Optional[float] = None
     ttft_s: Optional[float] = None
+    # The retry-after contract: set iff finish_reason == "shed" — seconds
+    # the client should wait before resubmitting (the overload gate's
+    # estimate of when the refusal reason will have cleared).
+    retry_after_s: Optional[float] = None

@@ -139,13 +139,24 @@ class HealthRouter:
         1/3 health — sick beats drowning, idle beats both."""
         return self.health_score(replica) / (1.0 + self.load(replica))
 
-    def pick(self, replicas: Sequence) -> Optional[object]:
+    def pick(self, replicas: Sequence,
+             qos: Optional[str] = None) -> Optional[object]:
         """The target for ONE admission: the routable replica (not fenced,
         queue open and not full, nonzero health) with the highest
         placement weight; ties break on name. None when nothing is
         routable — the caller holds the request (bounded fleet queue =
-        backpressure, never loss)."""
+        backpressure, never loss).
+
+        ``qos`` (serving/overload.py): non-interactive traffic PREFERS
+        replicas not currently burning a fast-window SLO budget — bulk
+        batch load steers away from replicas already failing their users,
+        so recovery headroom isn't spent on deferrable work. A soft
+        preference only: when every routable replica is burning, placement
+        falls back to the plain weighting (holding batch until burn
+        gauges decay would stall whole-batch workloads on a transient)."""
         best, best_weight = None, 0.0
+        calm_best, calm_weight = None, 0.0
+        prefer_calm = qos is not None and qos != "interactive"
         for rep in replicas:
             if rep.fenced or rep.sched.queue.closed or rep.sched.queue.full:
                 continue
@@ -156,7 +167,26 @@ class HealthRouter:
                 weight == best_weight and rep.name < best.name
             ):
                 best, best_weight = rep, weight
+            if prefer_calm and not self._burning(rep):
+                if calm_best is None or weight > calm_weight or (
+                    weight == calm_weight and rep.name < calm_best.name
+                ):
+                    calm_best, calm_weight = rep, weight
+        if prefer_calm and calm_best is not None:
+            return calm_best
         return best
+
+    @staticmethod
+    def _burning(replica) -> bool:
+        """Whether this replica's fast-window error or TTFT burn is over
+        1.0 (consuming its budget faster than sustainable)."""
+        reg = get_registry()
+        return any(
+            reg.read_value("slo_burn_rate", default=0.0,
+                           component="serving", replica=replica.name,
+                           slo=slo, window="fast") > 1.0
+            for slo in ("error_rate", "ttft_p95")
+        )
 
     # -- fence policy --------------------------------------------------------
 

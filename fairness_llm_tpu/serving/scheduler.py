@@ -76,7 +76,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fairness_llm_tpu.config import ModelSettings, ResilienceConfig, ServingConfig
+from fairness_llm_tpu.config import (
+    ModelSettings,
+    OverloadConfig,
+    ResilienceConfig,
+    ServingConfig,
+)
 from fairness_llm_tpu.models.tokenizer import _left_pad
 from fairness_llm_tpu.models.transformer import LayerCache, init_cache
 from fairness_llm_tpu.resilience.breaker import BreakerBoard
@@ -87,8 +92,18 @@ from fairness_llm_tpu.resilience.drain import (
 )
 from fairness_llm_tpu.resilience.watchdog import StepWatchdog
 from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
-from fairness_llm_tpu.serving.queue import AdmissionQueue
-from fairness_llm_tpu.serving.request import Request, Result
+from fairness_llm_tpu.serving.overload import (
+    DeadlineEstimator,
+    ShedController,
+    count_shed,
+)
+from fairness_llm_tpu.serving.queue import AdmissionQueue, ClassedAdmissionQueue
+from fairness_llm_tpu.serving.request import (
+    QOS_CLASSES,
+    QOS_PRIORITY,
+    Request,
+    Result,
+)
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 from fairness_llm_tpu.telemetry import (
     Heartbeat,
@@ -139,6 +154,7 @@ class ContinuousScheduler:
         journal: Optional[ServingJournal] = None,
         breakers: Optional[BreakerBoard] = None,
         replica: Optional[str] = None,
+        overload: Optional[OverloadConfig] = None,
     ):
         if engine.mesh is not None:
             raise ValueError(
@@ -191,13 +207,39 @@ class ContinuousScheduler:
         self.cache_len = self.max_prompt_bucket + cap
         self.num_slots = self.serving.num_slots
         self.pool = SlotPool(self.num_slots)
-        self.queue = AdmissionQueue(
-            capacity=self.serving.queue_capacity,
-            rate_limiter=(
-                RateLimiter(self.serving.admission_per_minute)
-                if self.serving.admission_per_minute else None
-            ),
-        )
+        # Overload control (serving/overload.py): with it armed, the queue
+        # becomes the per-class variant and the shed controller +
+        # deadline-feasibility estimator gate admission at this front door.
+        # A fleet replica's scheduler is NOT the front door (the ReplicaSet
+        # gates at its own intake), so the fleet passes overload=None here.
+        self.overload = overload if (overload is not None and
+                                     overload.enabled) else None
+        rate_limiter = (RateLimiter(self.serving.admission_per_minute)
+                        if self.serving.admission_per_minute else None)
+        if self.overload is not None:
+            self.queue: AdmissionQueue = ClassedAdmissionQueue(
+                capacity=self.serving.queue_capacity,
+                rate_limiter=rate_limiter, overload=self.overload,
+            )
+            self.shed_controller: Optional[ShedController] = ShedController(
+                self.overload, labels=self.labels,
+            )
+            self.deadline_estimator: Optional[DeadlineEstimator] = (
+                DeadlineEstimator(
+                    safety=self.overload.feasibility_safety,
+                    labels=self.labels,
+                ) if self.overload.deadline_admission else None
+            )
+        else:
+            self.queue = AdmissionQueue(
+                capacity=self.serving.queue_capacity,
+                rate_limiter=rate_limiter,
+            )
+            self.shed_controller = None
+            self.deadline_estimator = None
+        # Sheds recorded outside a drain (public submit() refusals between
+        # drains) — folded into the next drain's stats like rejections.
+        self._shed_untaken = 0
         # Persistent device state: the shared KV cache + each slot's carried
         # next-token logits (f32 — what the sampler consumes).
         self._cache = init_cache(cfg, self.num_slots, self.cache_len)
@@ -463,19 +505,28 @@ class ContinuousScheduler:
     def submit(self, request: Request, front: bool = False,
                restamp: bool = True) -> bool:
         """Queue one request; False = backpressure (queue full / rate
-        quota). The deadline/latency clock (re)starts here — a Request
-        object built ahead of time doesn't age before the server sees it.
-        ``front=True`` admits at the head of the line (the fleet's
-        migration path — see ``AdmissionQueue.submit``). ``restamp=False``
-        keeps the EXISTING ``submitted_at``: the fleet stamped the request
-        at its own intake, and re-stamping on routing (or on migration off
-        a fenced replica) would silently extend the deadline and hide the
-        fleet-queue wait from the latency — the same
+        quota) OR a terminal overload shed — the two read apart via
+        ``take_result``: a shed leaves a claimable ``finish_reason="shed"``
+        Result with a retry-after hint, backpressure leaves nothing (the
+        caller may simply retry). The deadline/latency clock (re)starts
+        here — a Request object built ahead of time doesn't age before the
+        server sees it. ``front=True`` admits at the head of the line (the
+        fleet's migration path — see ``AdmissionQueue.submit``).
+        ``restamp=False`` keeps the EXISTING ``submitted_at``: the fleet
+        stamped the request at its own intake, and re-stamping on routing
+        (or on migration off a fenced replica) would silently extend the
+        deadline and hide the fleet-queue wait from the latency — the same
         deadline-from-first-submission contract ``resume-serving``
         preserves by shrinking resumed deadlines."""
         self._check_settings(request)
         if restamp:
             request.submitted_at = time.monotonic()
+        # Overload gate BEFORE acceptance: a shed request was never
+        # accepted, so it carries no journal obligation (the journal's
+        # zero-lost contract covers accepted work; the shed Result is the
+        # explicit refusal).
+        if self._overload_gate(request, journaled=False):
+            return False
         accepted = self.queue.submit(request, front=front)
         if accepted:
             # Rejections are NOT recorded here: queue.rejected already counts
@@ -576,6 +627,15 @@ class ContinuousScheduler:
         # the fast/slow burn gauges age out during quiet stretches instead
         # of freezing at the last terminal request's value.
         self.tracer.slo.maybe_evaluate()
+        if self.shed_controller is not None:
+            # Overload controller tick: one depth sample per loop
+            # iteration (the self-decaying window the controller judges),
+            # then a throttled ladder step — AFTER the SLO decay above so
+            # the burn gauges it reads are current.
+            self.shed_controller.observe_queue_depth(
+                len(self.queue), self.serving.queue_capacity,
+            )
+            self.shed_controller.maybe_evaluate()
         progressed = self._iterate(stats)
         self._feed(stats)
         self._heartbeat.poke(
@@ -593,6 +653,11 @@ class ContinuousScheduler:
         record)."""
         stats.rejected = self.queue.rejected - self._rejected_taken
         self._rejected_taken = self.queue.rejected
+        # Sheds from public submit() calls between drains (the gate runs
+        # outside any drain's stats there) — same delta pattern as
+        # rejections above.
+        stats.shed += self._shed_untaken
+        self._shed_untaken = 0
         stats.publish(labels=self.labels)
         # Reset the LIVE high-water mark to the (now drained) depth: the
         # gauge is a per-drain-window worst case for online readers (the
@@ -725,10 +790,36 @@ class ContinuousScheduler:
         # here is a RETRY of an already-accepted request, not a refused
         # submission, so it must not count toward stats.rejected (which
         # records public submit() backpressure).
-        while self._pending and not self.queue.full:
-            if not self.queue.submit(self._pending[0], count_rejection=False):
-                break  # rate-limited; retry next iteration
-            self._pending.popleft()
+        if self.shed_controller is None:
+            while self._pending and not self.queue.full:
+                if not self.queue.submit(self._pending[0],
+                                         count_rejection=False):
+                    break  # rate-limited; retry next iteration
+                self._pending.popleft()
+            return
+        # QoS mode: the overload gate runs here for serve()'s intake, and a
+        # bounded/quota'd CLASS must not head-of-line-block other classes'
+        # pending behind it — scan the whole overflow once, keeping refused
+        # requests in order and skipping a class after its first refusal
+        # (per-class isolation; the scan short-circuits once every class is
+        # blocked, so a deep overflow costs one pass, not one per entry).
+        blocked: set = set()
+        kept: Deque[Request] = deque()
+        while self._pending:
+            if len(blocked) == len(QOS_CLASSES):
+                kept.extend(self._pending)
+                self._pending.clear()
+                break
+            req = self._pending.popleft()
+            if req.qos in blocked:
+                kept.append(req)
+                continue
+            if self._overload_gate(req, stats=stats):
+                continue  # terminally shed, Result recorded
+            if not self.queue.submit(req, count_rejection=False):
+                blocked.add(req.qos)
+                kept.append(req)
+        self._pending = kept
 
     def _fail(self, request: Request, reason: str, error: str,
               stats: ServingStats, tokens: Optional[List[int]] = None) -> None:
@@ -750,6 +841,85 @@ class ContinuousScheduler:
             stats.expired += 1
         else:
             stats.failed += 1
+
+    def _shed(self, request: Request, reason: str, error: str,
+              retry_after: float, stats: Optional[ServingStats] = None,
+              journaled: bool = True) -> None:
+        """Terminal overload refusal: an explicit ``finish_reason="shed"``
+        Result with a retry-after hint — never silent loss. ``journaled``
+        says whether intake already ledgered the request (serve()'s path);
+        a submit()-time shed was never accepted, so there is nothing to
+        close out."""
+        if not self.tracer.events(request.id):
+            # Lifecycle completeness for gate-at-submit sheds: the span
+            # must still start at "submitted" (assert_span_order).
+            self.tracer.record(request.id, "submitted",
+                               t=request.submitted_at)
+        row = self.tracer.finalize(request.id, "shed", tokens=0)
+        self._results[request.id] = Result(
+            id=request.id, ok=False, finish_reason="shed", error=error,
+            retries=request.retries,
+            latency_s=time.monotonic() - request.submitted_at,
+            queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
+            retry_after_s=retry_after,
+        )
+        count_shed(request.qos, reason, labels=self.labels)
+        if journaled and self.journal is not None:
+            self.journal.record_terminal(request.id, "shed")
+        if stats is not None:
+            stats.shed += 1
+        else:
+            self._shed_untaken += 1
+
+    def _overload_gate(self, request: Request,
+                       stats: Optional[ServingStats] = None,
+                       journaled: bool = True) -> bool:
+        """True when overload control terminally shed ``request`` (the
+        Result is recorded — claimable via ``take_result`` or delivered by
+        ``serve``). Two gates, in order: the brownout ladder's class
+        admission, then deadline feasibility (see serving/overload.py)."""
+        ctl = self.shed_controller
+        if ctl is None:
+            return False
+        if request.qos == "interactive":
+            # Arms the burn signal: there is now a latency-sensitive
+            # tenant the brownout ladder exists to protect.
+            ctl.note_interactive()
+        if not ctl.admits(request.qos):
+            self._shed(
+                request, "overload",
+                f"overload level {ctl.level} ({ctl.rung}) sheds "
+                f"{request.qos}-class admissions; retry after "
+                f"{ctl.retry_after()}s",
+                ctl.retry_after(), stats=stats, journaled=journaled,
+            )
+            return True
+        if self.deadline_estimator is not None and \
+                request.deadline_s is not None:
+            # Queued-ahead = same-or-higher-priority depth: class isolation
+            # means lower classes can age past this request occasionally
+            # but never systematically delay it, so they stay out of the
+            # lower bound.
+            if isinstance(self.queue, ClassedAdmissionQueue):
+                ahead = sum(
+                    d for c, d in self.queue.class_depths().items()
+                    if QOS_PRIORITY[c] <= QOS_PRIORITY[request.qos]
+                )
+            else:
+                ahead = len(self.queue)
+            est = self.deadline_estimator.infeasible(
+                request, ahead, self.num_slots, self.decode_chunk,
+            )
+            if est is not None:
+                self._shed(
+                    request, "deadline_infeasible",
+                    "deadline provably unmeetable at admission "
+                    f"(estimated earliest first token {est:.3f}s); not "
+                    "prefilling a doomed request",
+                    ctl.retry_after(est), stats=stats, journaled=journaled,
+                )
+                return True
+        return False
 
     def _preempt(self, request: Request, stats: ServingStats) -> None:
         """Drain outcome for a request this process will not finish: a
@@ -814,7 +984,15 @@ class ContinuousScheduler:
 
     def _cap_for(self, request: Request) -> int:
         m = (request.settings or self.settings).max_tokens
-        return max(1, min(m, self.serving.max_new_tokens))
+        cap = max(1, min(m, self.serving.max_new_tokens))
+        if self.shed_controller is not None:
+            # Brownout rung 2+: batch budgets clamp to batch_token_cap.
+            # Greedy output stays a token-for-token PREFIX of the uncapped
+            # stream (the cap only stops it sooner); a row already past a
+            # freshly-shrunk cap finishes "length" at its next eviction
+            # sweep, at most one chunk later.
+            cap = self.shed_controller.batch_cap(cap, request.qos)
+        return cap
 
     def _admit(self, stats: ServingStats) -> bool:
         """Backfill free slots from the queue until one side runs dry,
@@ -855,6 +1033,25 @@ class ContinuousScheduler:
                 self._fail(req, "deadline", "deadline expired before prefill",
                            stats)
                 continue
+            if self.deadline_estimator is not None and \
+                    req.deadline_s is not None:
+                # Pop-time feasibility recheck (queue wait now spent, so
+                # ahead=0): a request whose remaining deadline cannot even
+                # cover one prefill + one decode step sheds HERE instead of
+                # burning a full prefill and expiring mid-decode.
+                est = self.deadline_estimator.infeasible(
+                    req, 0, self.num_slots, self.decode_chunk, now=now,
+                )
+                if est is not None:
+                    self._shed(
+                        req, "deadline_infeasible",
+                        "deadline provably unmeetable at prefill time "
+                        f"(estimated earliest first token {est:.3f}s)",
+                        self.shed_controller.retry_after(est)
+                        if self.shed_controller is not None else est,
+                        stats=stats,
+                    )
+                    continue
             if self.fault_injector is not None:
                 try:
                     self.fault_injector.maybe_fail(req.id, "prefill")

@@ -27,7 +27,12 @@ one number instead of re-deriving judgment from histograms:
 
 ``preempted`` outcomes are excluded entirely: preemption is infrastructure
 scheduling (the request resumes in a successor process), not service
-failure — counting it would page on every drain.
+failure — counting it would page on every drain. ``shed`` outcomes are
+excluded for the inverse reason: deliberate load shedding
+(serving/overload.py) is the CONTROLLER acting on these burn rates, and
+feeding its own refusals back into the error burn would lock the brownout
+ladder at its top rung. Sheds are first-class observable via
+``shed_total{class,reason}`` instead.
 
 The ``slo-report`` CLI subcommand renders these from a snapshot
 (``render_slo_report``). Observation gates on the attribution switch
@@ -118,8 +123,8 @@ class SLOEvaluator:
                 e2e_s: Optional[float] = None,
                 t: Optional[float] = None) -> Optional[Dict]:
         """Ingest one terminal request and re-evaluate every window.
-        Returns the burn rates (None when gated off / preempted)."""
-        if not attribution_on() or outcome == "preempted":
+        Returns the burn rates (None when gated off / preempted / shed)."""
+        if not attribution_on() or outcome in ("preempted", "shed"):
             return None
         tg = self.targets
         now = self._clock() if t is None else float(t)

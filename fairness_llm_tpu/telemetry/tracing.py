@@ -53,12 +53,14 @@ from fairness_llm_tpu.telemetry.timeline import get_timeline
 # admitted and a later (second) admitted; terminal events appear exactly once.
 # ``preempted`` is terminal FOR THIS PROCESS only: the request was drained to
 # the serving journal (resilience/drain.py) and a resume-serving run gives it
-# a fresh lifecycle under the same id.
+# a fresh lifecycle under the same id. ``shed`` is overload control's
+# explicit refusal (serving/overload.py) — terminal with a retry-after
+# hint, so the client owns the retry.
 LIFECYCLE_EVENTS = (
     "submitted", "admitted", "prefill_start", "first_token",
-    "requeued", "completed", "failed", "expired", "preempted",
+    "requeued", "completed", "failed", "expired", "preempted", "shed",
 )
-TERMINAL_EVENTS = ("completed", "failed", "expired", "preempted")
+TERMINAL_EVENTS = ("completed", "failed", "expired", "preempted", "shed")
 
 
 @dataclasses.dataclass

@@ -287,6 +287,10 @@ class ServingStats:
     - ``preempted``: requests a graceful drain handed to the serving
       journal instead of finishing (``resilience/drain.py``) — terminal
       for this process, resumable by the next
+    - ``shed``: requests overload control refused with an explicit
+      terminal Result + retry-after (``serving/overload.py``) — class
+      brownout or deadline-infeasibility, broken down in
+      ``shed_total{class,reason}``
     - ``rejected``: submissions refused at the queue (capacity/rate)
     - ``requeued``: fault-hit slots sent back for one retry
     - ``prefill_batches`` / ``prefill_tokens``: compiled prefill forwards and
@@ -306,6 +310,7 @@ class ServingStats:
     failed: int = 0
     expired: int = 0
     preempted: int = 0
+    shed: int = 0
     rejected: int = 0
     requeued: int = 0
     prefill_batches: int = 0
@@ -367,8 +372,9 @@ class ServingStats:
         lbl = dict(labels or {})
         for name in (
             "admitted", "completed", "failed", "expired", "preempted",
-            "rejected", "requeued", "prefill_batches", "prefill_tokens",
-            "decode_steps", "decoded_tokens", "loop_iterations",
+            "shed", "rejected", "requeued", "prefill_batches",
+            "prefill_tokens", "decode_steps", "decoded_tokens",
+            "loop_iterations",
         ):
             reg.counter(f"serving_{name}_total", component=component,
                         **lbl).inc(getattr(self, name))

@@ -40,6 +40,17 @@ class RateLimiter:
         self._times.append(now)
         return True
 
+    def can_acquire(self) -> bool:
+        """Non-consuming peek: would ``try_acquire`` succeed right now?
+        For callers gating on SEVERAL limiters at once (the classed
+        admission queue checks a per-class quota AND the shared one) —
+        consuming one limiter's token and then failing the other would
+        burn quota on a submission that was never admitted."""
+        now = time.monotonic()
+        while self._times and now - self._times[0] >= self.window:
+            self._times.popleft()
+        return len(self._times) < self.calls_per_minute
+
     def wait_if_needed(self) -> float:
         """Block until a call is allowed; returns seconds slept."""
         now = time.monotonic()

@@ -268,7 +268,13 @@ def test_engine_vmem_compile_fallback(monkeypatch):
             state["raised"] = True
 
             def boom(*a, **k):
-                raise RuntimeError(
+                # The realistic shape: Mosaic rejections surface through
+                # the XLA runtime layer (jax.errors.JaxRuntimeError is the
+                # XlaRuntimeError alias), which is what the engine's
+                # narrowed compile-error check matches on.
+                import jax
+
+                raise jax.errors.JaxRuntimeError(
                     "Ran out of scoped vmem while compiling the kernel"
                 )
 
@@ -283,6 +289,9 @@ def test_engine_vmem_compile_fallback(monkeypatch):
     )
     assert state["raised"]
     assert not eng.config.use_decode_attention_kernel  # fell back
+    # The downgrade is RECORDED: stats carry the effective attention path,
+    # so a record produced past a gate miss can't claim kernel provenance.
+    assert out.stats["decode_kernel"] is False
     assert len(out.texts) == 2
 
     # A non-VMEM error (or one with the kernel already off) still raises.
@@ -298,3 +307,19 @@ def test_engine_vmem_compile_fallback(monkeypatch):
     eng2 = DecodeEngine(cfg, seed=0)
     with pytest.raises(RuntimeError, match="unrelated"):
         eng2.generate(["x"], ModelSettings(temperature=0.0, max_tokens=2))
+
+    # Narrowed catch: an arbitrary PYTHON exception that merely mentions
+    # 'vmem' is NOT a kernel compile failure and must propagate instead of
+    # silently downgrading the engine (the old substring-only match
+    # absorbed it).
+    def fake_lookalike(self, *args, **kwargs):
+        def boom(*a, **k):
+            raise RuntimeError("user callback touched vmem stats")
+
+        return boom
+
+    monkeypatch.setattr(DecodeEngine, "_decode_fn", fake_lookalike)
+    eng3 = DecodeEngine(cfg, seed=0)
+    with pytest.raises(RuntimeError, match="vmem stats"):
+        eng3.generate(["x"], ModelSettings(temperature=0.0, max_tokens=2))
+    assert eng3.config.use_decode_attention_kernel  # NOT downgraded

@@ -1,0 +1,505 @@
+"""Overload-control tests (serving/overload.py + the QoS admission queue).
+
+ISSUE-8 contracts under unit test:
+
+- per-class queue isolation: a batch flood never blocks an interactive
+  admission (strict priority), and aging bounds batch starvation;
+- deadline-feasibility math: the TTFT lower bound is exact arithmetic over
+  the live p50s, cold start never rejects, and a provably-doomed request
+  sheds with a retry-after instead of burning a prefill;
+- shed semantics: every shed is an explicit terminal Result
+  (``finish_reason="shed"`` + ``retry_after_s``), counted in
+  ``shed_total{class,reason}``, excluded from SLO burn;
+- the brownout ladder's rung effects (class admission, batch token cap);
+- greedy token parity for every ADMITTED request across classes;
+- the fleet intake gate and the router's qos-aware placement.
+
+The controller's transition monotonicity/hysteresis has its own
+property-test module (tests/test_overload_property.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import ModelSettings, OverloadConfig, ServingConfig
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import (
+    ClassedAdmissionQueue,
+    ContinuousScheduler,
+    DeadlineEstimator,
+    HealthRouter,
+    Request,
+    ShedController,
+)
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.telemetry.registry import get_registry
+from fairness_llm_tpu.telemetry.slo import SLOTargets, set_slo_targets
+
+GREEDY_TTFT_SAFE = SLOTargets(ttft_p95_s=300.0, e2e_p99_s=600.0)
+
+
+def greedy(m: int) -> ModelSettings:
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+SCFG = ServingConfig(
+    enabled=True, num_slots=2, queue_capacity=64,
+    max_prompt_len=192, max_new_tokens=32, decode_chunk=4,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+@pytest.fixture()
+def safe_slo():
+    """Harness-appropriate SLO targets: compile-time TTFT outliers must not
+    drive escalation in tests that exercise other signals."""
+    prev = set_slo_targets(GREEDY_TTFT_SAFE)
+    yield
+    set_slo_targets(prev)
+
+
+def _req(prompt, m=8, **kw):
+    return Request(prompt=prompt, settings=greedy(m), **kw)
+
+
+# -- Request.qos --------------------------------------------------------------
+
+
+def test_unknown_qos_rejected_loudly():
+    with pytest.raises(ValueError, match="qos"):
+        Request(prompt="x", qos="bulk")
+
+
+# -- ClassedAdmissionQueue ----------------------------------------------------
+
+
+def test_strict_priority_dequeue():
+    q = ClassedAdmissionQueue(capacity=16, overload=OverloadConfig(
+        enabled=True, aging_s=0.0))
+    b = Request(prompt="b", qos="batch")
+    p = Request(prompt="p", qos="probe")
+    i = Request(prompt="i", qos="interactive")
+    for r in (b, p, i):  # arrival order: batch, probe, interactive
+        assert q.submit(r)
+    assert [r.qos for r in q.pop(3)] == ["interactive", "batch", "probe"]
+
+
+def test_batch_flood_never_blocks_interactive_admission():
+    """Class isolation: with the batch sub-queue at its bound, interactive
+    submits still succeed, and the next pop serves interactive first — a
+    flood delays an interactive admission by at most the chunk in flight,
+    never by the flood's length."""
+    ov = OverloadConfig(enabled=True, batch_capacity=4, aging_s=0.0)
+    q = ClassedAdmissionQueue(capacity=64, overload=ov)
+    for k in range(8):
+        ok = q.submit(Request(prompt=f"b{k}", qos="batch"))
+        assert ok == (k < 4)  # the class bound backpressures the flood
+    late = Request(prompt="i", qos="interactive")
+    assert q.submit(late)  # interactive unaffected by the full batch class
+    assert q.pop(1)[0] is late
+
+
+def test_aging_promotes_starved_batch():
+    clock = {"t": 100.0}
+    ov = OverloadConfig(enabled=True, aging_s=5.0)
+    q = ClassedAdmissionQueue(capacity=16, overload=ov,
+                              clock=lambda: clock["t"])
+    old_batch = Request(prompt="b", qos="batch", submitted_at=90.0)
+    fresh_int = Request(prompt="i", qos="interactive", submitted_at=99.9)
+    assert q.submit(old_batch) and q.submit(fresh_int)
+    # The batch head has waited 10s >= aging_s: promoted, oldest-first.
+    assert q.pop(1)[0] is old_batch
+    assert q.pop(1)[0] is fresh_int
+
+
+def test_requeue_stays_in_own_class():
+    q = ClassedAdmissionQueue(capacity=16, overload=OverloadConfig(
+        enabled=True, aging_s=0.0))
+    now = time.monotonic()
+    assert q.submit(Request(prompt="i", qos="interactive", submitted_at=now))
+    faulted = Request(prompt="b", qos="batch", submitted_at=now)
+    q.requeue(faulted)  # front of BATCH, not of the whole line
+    assert q.pop(1)[0].qos == "interactive"
+    assert q.pop(1)[0] is faulted
+
+
+def test_shared_rejection_does_not_burn_class_quota():
+    """Quota peek-then-consume: a submission the SHARED limiter rejects
+    must not have consumed a per-class token (and vice versa) — burning
+    quota on never-admitted work under-admits the class for the rest of
+    its window."""
+    ov = OverloadConfig(enabled=True, interactive_per_minute=10)
+    from fairness_llm_tpu.utils.ratelimit import RateLimiter
+
+    q = ClassedAdmissionQueue(capacity=16, overload=ov,
+                              rate_limiter=RateLimiter(calls_per_minute=1))
+    assert q.submit(Request(prompt="i0", qos="interactive"))
+    assert not q.submit(Request(prompt="i1", qos="interactive"))  # shared
+    # Only the ADMITTED submission spent a class token.
+    assert len(q._class_limiters["interactive"]._times) == 1
+    assert len(q.rate_limiter._times) == 1
+
+
+def test_journal_preserves_qos(tmp_path):
+    """A drained batch request must resume as BATCH: the journal carries
+    the class, so a successor process's brownout/priority machinery sees
+    the same traffic shape (and old journals without the field default to
+    interactive)."""
+    from fairness_llm_tpu.resilience.drain import ServingJournal
+
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(Request(prompt="x", id="b", qos="batch"))
+    rebuilt = j.to_requests()
+    assert [r.qos for r in rebuilt] == ["batch"]
+    legacy = j.to_requests([{"prompt": "y", "id": "old"}])  # pre-QoS spec
+    assert legacy[0].qos == "interactive"
+
+
+def test_per_class_rate_limit_and_expiry_sweep():
+    ov = OverloadConfig(enabled=True, batch_per_minute=1)
+    q = ClassedAdmissionQueue(capacity=16, overload=ov)
+    assert q.submit(Request(prompt="b0", qos="batch"))
+    assert not q.submit(Request(prompt="b1", qos="batch"))  # quota spent
+    assert q.rejected == 1
+    assert q.submit(Request(prompt="i", qos="interactive"))  # own quota
+    expired = Request(prompt="x", qos="interactive", deadline_s=0.0)
+    q.requeue(expired)
+    out = q.drain_expired()
+    assert out == [expired] and len(q) == 2
+
+
+# -- DeadlineEstimator --------------------------------------------------------
+
+
+def _feed_histograms(prefill_s, per_tok_s, n=10):
+    reg = get_registry()
+    for _ in range(n):
+        reg.histogram("prefill_wall_s", component="serving").observe(prefill_s)
+        reg.histogram("per_output_token_s",
+                      component="serving").observe(per_tok_s)
+
+
+def test_estimator_cold_start_never_rejects():
+    with use_registry():
+        est = DeadlineEstimator(safety=1.0)
+        assert est.estimate_ttft_s(100, 2, 4) is None
+        req = Request(prompt="x", deadline_s=0.001, submitted_at=0.0)
+        assert est.infeasible(req, 100, 2, 4, now=0.0005) is None
+
+
+def test_estimator_ttft_lower_bound_math():
+    with use_registry():
+        _feed_histograms(prefill_s=0.1, per_tok_s=0.01)
+        est = DeadlineEstimator(safety=0.5)
+        # 10 ahead on 2 slots = 5 waves x (4 steps x 10ms) + prefill + 1 tok
+        bound = est.estimate_ttft_s(10, 2, 4)
+        assert bound == pytest.approx(5 * 0.04 + 0.1 + 0.01)
+        # 1 ahead on 2 slots floors to 0 waves: prefill + one step only.
+        assert est.estimate_ttft_s(1, 2, 4) == pytest.approx(0.11)
+
+
+def test_estimator_infeasible_vs_feasible():
+    with use_registry():
+        _feed_histograms(prefill_s=0.1, per_tok_s=0.01)
+        est = DeadlineEstimator(safety=0.5)
+        # Bound = 0.31s; safety-discounted threshold = 0.155s.
+        doomed = Request(prompt="x", deadline_s=0.1, submitted_at=0.0)
+        assert est.infeasible(doomed, 10, 2, 4, now=0.0) == \
+            pytest.approx(0.31)
+        fine = Request(prompt="x", deadline_s=1.0, submitted_at=0.0)
+        assert est.infeasible(fine, 10, 2, 4, now=0.0) is None
+        # Already past its deadline: infeasible by definition.
+        late = Request(prompt="x", deadline_s=0.1, submitted_at=0.0)
+        assert est.infeasible(late, 0, 2, 4, now=0.2) is not None
+        # safety=0 disables the check entirely.
+        off = DeadlineEstimator(safety=0.0)
+        assert off.infeasible(doomed, 10, 2, 4, now=0.0) is None
+
+
+# -- ShedController rung semantics -------------------------------------------
+
+
+def test_ladder_rung_admission_and_caps():
+    with use_registry():
+        ctl = ShedController(OverloadConfig(enabled=True, batch_token_cap=4))
+        assert all(ctl.admits(q) for q in ("interactive", "batch", "probe"))
+        assert ctl.batch_cap(32, "batch") == 32
+        ctl._transition(1, "test", 0.0)
+        assert ctl.admits("interactive") and ctl.admits("probe")
+        assert not ctl.admits("batch")
+        assert ctl.batch_cap(32, "batch") == 32  # rung 1: no cap yet
+        ctl._transition(2, "test", 0.0)
+        assert ctl.batch_cap(32, "batch") == 4
+        assert ctl.batch_cap(32, "interactive") == 32  # never touched
+        ctl._transition(3, "test", 0.0)
+        assert ctl.admits("interactive")
+        assert not ctl.admits("batch") and not ctl.admits("probe")
+        # Retry-after scales with the rung depth.
+        assert ctl.retry_after() == pytest.approx(3.0)
+        assert ctl.retry_after(est_ttft=10.0) == pytest.approx(10.0)
+
+
+def test_controller_signals_depth_and_burn():
+    clock = {"t": 0.0}
+    with use_registry():
+        ctl = ShedController(
+            OverloadConfig(enabled=True, queue_frac_threshold=0.5,
+                           queue_window_s=1.0, burn_threshold=2.0,
+                           eval_interval_s=0.0),
+            clock=lambda: clock["t"],
+        )
+        assert ctl.overloaded() is None
+        ctl.observe_queue_depth(depth=60, capacity=100)
+        assert "queue_depth" in ctl.overloaded()
+        clock["t"] += 2.0  # the depth sample ages out of the window
+        assert ctl.overloaded() is None
+        get_registry().gauge("slo_burn_rate", component="serving",
+                             slo="error_rate", window="fast").set(3.0)
+        # Burn alone is gated on interactive presence: a single-tenant
+        # batch run burning its OWN ttft budget must not brown itself out.
+        assert ctl.overloaded() is None
+        ctl.note_interactive()
+        assert "slo_burn" in ctl.overloaded()
+        clock["t"] += 100.0  # presence expires (interactive_presence_s=60)
+        assert ctl.overloaded() is None
+
+
+# -- scheduler integration ----------------------------------------------------
+
+
+def test_submit_shed_is_terminal_with_retry_after(engine, safe_slo):
+    with use_registry():
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    overload=OverloadConfig(enabled=True))
+        sched.shed_controller._transition(1, "test", 0.0)
+        req = _req("the quick brown fox", qos="batch", id="shed_me")
+        assert not sched.submit(req)
+        res = sched.take_result("shed_me")
+        assert res is not None and res.finish_reason == "shed"
+        assert res.retry_after_s and res.retry_after_s > 0
+        assert not res.ok
+        reg = get_registry()
+        assert reg.read_value("shed_total", component="serving",
+                              **{"class": "batch",
+                                 "reason": "overload"}) == 1
+        # Shed is excluded from the SLO burn windows (flow control, not
+        # service failure) but counted as a finished outcome.
+        assert reg.read_value("requests_finished_total",
+                              component="serving", outcome="shed") == 1
+        assert sched.tracer.slo._run[0] == 0  # no SLO observation
+
+
+def test_served_parity_across_classes_and_shed_cycles(engine, safe_slo):
+    """Greedy token-for-token parity for every ADMITTED request, whatever
+    class it rode and despite a shed/restore cycle mid-workload."""
+    prompts = ["the quick brown fox", "hello there friend",
+               "one two three four", "a b c d e"]
+    with use_registry():
+        refs = {p: engine.generate([p], greedy(8)).tokens[0]
+                for p in prompts}
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    overload=OverloadConfig(enabled=True))
+        reqs = [_req(p, id=f"par{i}",
+                     qos="interactive" if i % 2 == 0 else "batch")
+                for i, p in enumerate(prompts)]
+        results = sched.serve(reqs)
+        assert all(r.ok for r in results)
+        for r, p in zip(results, prompts):
+            n = len(r.tokens)
+            assert n > 0
+            assert np.array_equal(np.asarray(r.tokens), refs[p][:n])
+        # Shed cycle: escalate, shed one, restore, serve again — identical.
+        sched.shed_controller._transition(3, "test", 0.0)
+        assert not sched.submit(_req(prompts[0], qos="batch", id="mid"))
+        assert sched.take_result("mid").finish_reason == "shed"
+        sched.shed_controller._transition(0, "test", 0.0)
+        again = sched.serve([_req(p, id=f"re{i}", qos="batch")
+                             for i, p in enumerate(prompts)])
+        for r, p in zip(again, prompts):
+            assert r.ok
+            assert np.array_equal(np.asarray(r.tokens),
+                                  refs[p][:len(r.tokens)])
+
+
+def test_doomed_deadline_sheds_without_prefill(engine, safe_slo):
+    with use_registry():
+        _feed_histograms(prefill_s=0.05, per_tok_s=0.01)
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    overload=OverloadConfig(enabled=True))
+        # Stack the queue so the wave estimate is meaningful.
+        for i in range(6):
+            assert sched.submit(_req("hello there friend", id=f"ahead{i}"))
+        doomed = _req("the quick brown fox", id="doomed", deadline_s=0.001)
+        assert not sched.submit(doomed)
+        res = sched.take_result("doomed")
+        assert res.finish_reason == "shed"
+        assert "unmeetable" in res.error
+        assert res.retry_after_s > 0
+        reg = get_registry()
+        assert reg.read_value("shed_total", component="serving",
+                              **{"class": "interactive",
+                                 "reason": "deadline_infeasible"}) == 1
+        # No prefill was spent on it: the queue still only holds the six.
+        assert len(sched.queue) == 6
+        stats = sched.drain()
+        assert stats.completed == 6
+        assert stats.shed == 1  # folded in at finish_stats
+
+
+def test_brownout_flood_serves_interactive_sheds_batch(engine, safe_slo):
+    """A miniature of the chaos drill's section 7: 3x-capacity mixed flood
+    -> batch sheds with retry-after, interactive all served, level returns
+    to 0, zero accepted-then-lost."""
+    scfg = ServingConfig(enabled=True, num_slots=2, queue_capacity=8,
+                         max_prompt_len=192, max_new_tokens=32,
+                         decode_chunk=4)
+    ov = OverloadConfig(enabled=True, queue_frac_threshold=0.75,
+                        queue_window_s=0.3, healthy_window_s=0.01,
+                        eval_interval_s=0.0, batch_token_cap=4)
+    with use_registry():
+        sched = ContinuousScheduler(engine, scfg, settings=greedy(8),
+                                    overload=ov)
+        flood = [_req("hello there friend", id=f"b{i:02d}", qos="batch")
+                 for i in range(20)]
+        flood += [_req("the quick brown fox", id=f"i{i}", qos="interactive")
+                  for i in range(4)]
+        results = {r.id: r for r in sched.serve(flood)}
+        assert len(results) == len(flood)  # zero lost
+        assert all(results[f"i{i}"].ok for i in range(4))
+        shed = [r for r in results.values() if r.finish_reason == "shed"]
+        assert shed and all(r.retry_after_s for r in shed)
+        served = [r for r in results.values() if r.finish_reason != "shed"]
+        assert all(r.ok for r in served)
+        # De-escalation: evaluate until the depth window ages out.
+        ctl = sched.shed_controller
+        deadline = time.monotonic() + 5.0
+        while ctl.level > 0 and time.monotonic() < deadline:
+            ctl.evaluate()
+            time.sleep(0.01)
+        assert ctl.level == 0
+
+
+def test_batch_token_cap_applies_at_rung_two(engine, safe_slo):
+    with use_registry():
+        sched = ContinuousScheduler(
+            engine, SCFG, settings=greedy(8),
+            overload=OverloadConfig(enabled=True, batch_token_cap=2),
+        )
+        ref = engine.generate(["hello there friend"], greedy(8)).tokens[0]
+        sched.shed_controller._transition(2, "test", 0.0)
+        # Batch sheds at rung 2 — but an already-queued batch request (or
+        # one submitted below rung 1... here we exercise the cap directly).
+        req = _req("hello there friend", id="capped", qos="batch")
+        assert sched._cap_for(req) == 2
+        assert sched._cap_for(_req("x", id="i", qos="interactive")) == 8
+        sched.shed_controller._transition(0, "test", 0.0)
+        assert sched._cap_for(req) == 8
+        del ref
+
+
+def test_canary_probe_shed_is_inconclusive(engine, safe_slo):
+    """A shed canary probe must NOT count as a mismatch or trip the
+    breaker — flow control is not a fault (rung 3 sheds probes)."""
+    from fairness_llm_tpu.integrity.canary import CanaryProbe
+    from fairness_llm_tpu.resilience import BreakerBoard
+
+    with use_registry():
+        board = BreakerBoard(failure_threshold=3, cooldown_s=60.0)
+        sched = ContinuousScheduler(engine, SCFG, settings=greedy(8),
+                                    overload=OverloadConfig(enabled=True),
+                                    breakers=board)
+        probe = CanaryProbe.record(engine, max_tokens=8, every_n=1,
+                                   board=board)
+        assert probe.probe(sched)  # healthy: matches
+        sched.shed_controller._transition(3, "test", 0.0)
+        assert probe.probe(sched)  # shed: inconclusive, not a mismatch
+        reg = get_registry()
+        assert reg.read_value("canary_mismatch_total",
+                              component="serving") == 0
+        assert board.state("decode") == "closed"
+
+
+# -- fleet + router -----------------------------------------------------------
+
+
+class _StubQueue:
+    def __init__(self):
+        self.full, self.closed = False, False
+
+    def __len__(self):
+        return 0
+
+
+class _StubPool:
+    occupancy = 0
+
+
+class _StubSched:
+    def __init__(self):
+        self.pool = _StubPool()
+        self.queue = _StubQueue()
+        self._pending = []
+        self.breakers = None
+        self.watchdog = None
+        self.num_slots = 4
+
+
+class _StubReplica:
+    def __init__(self, name):
+        self.name = name
+        self.fenced = False
+        self.sched = _StubSched()
+
+
+def test_router_steers_batch_away_from_burning_replica():
+    with use_registry():
+        router = HealthRouter()
+        calm, hot = _StubReplica("r0"), _StubReplica("r1")
+        get_registry().gauge("slo_burn_rate", component="serving",
+                             replica="r1", slo="error_rate",
+                             window="fast").set(5.0)
+        # Interactive: plain weighting (burn already discounts health via
+        # health_score, but both stay routable).
+        assert router.pick([calm, hot], qos="interactive") is calm
+        # Batch prefers the calm replica outright...
+        assert router.pick([calm, hot], qos="batch") is calm
+        # ...and falls back to plain weighting when EVERYONE is burning.
+        get_registry().gauge("slo_burn_rate", component="serving",
+                             replica="r0", slo="error_rate",
+                             window="fast").set(5.0)
+        assert router.pick([calm, hot], qos="batch") is not None
+
+
+def test_fleet_intake_gate_sheds_and_recovers(engine, safe_slo):
+    from fairness_llm_tpu.config import FleetConfig
+    from fairness_llm_tpu.serving import ReplicaSet
+
+    with use_registry():
+        fleet = ReplicaSet(engine, SCFG, settings=greedy(8),
+                           fleet=FleetConfig(replicas=2),
+                           overload=OverloadConfig(enabled=True))
+        refs = {p: engine.generate([p], greedy(8)).tokens[0]
+                for p in ("the quick brown fox", "hello there friend")}
+        fleet.shed_controller._transition(3, "test", 0.0)
+        out = {r.id: r for r in fleet.serve([
+            _req("the quick brown fox", id="fi", qos="interactive"),
+            _req("hello there friend", id="fb", qos="batch"),
+        ])}
+        assert out["fi"].ok
+        assert np.array_equal(np.asarray(out["fi"].tokens),
+                              refs["the quick brown fox"][:len(out["fi"].tokens)])
+        assert out["fb"].finish_reason == "shed" and out["fb"].retry_after_s
+        assert fleet.last_stats.shed == 1
+        fleet.shed_controller._transition(0, "test", 0.0)
+        out2 = fleet.serve([_req("hello there friend", id="fb2",
+                                 qos="batch")])[0]
+        assert out2.ok
+        assert np.array_equal(np.asarray(out2.tokens),
+                              refs["hello there friend"][:len(out2.tokens)])

@@ -1,0 +1,127 @@
+"""Property-based ShedController invariants (hypothesis over random
+burn/load/clock sequences) — sibling of tests/test_breaker_property.py.
+
+The brownout ladder gates live admission at every serving front door, so
+its invariants are load-bearing for the overload drill's guarantees:
+
+1. **Monotone per evaluation**: one ``evaluate()`` moves the level by at
+   most ONE rung, stays in [0, 3], escalates only while a signal is hot,
+   and de-escalates only while everything is healthy — never a jump, never
+   a move against the signal.
+2. **Hysteresis**: every de-escalation is preceded by at least
+   ``healthy_window_s`` of hot-signal-free clock time since the later of
+   (the last hot evaluation, the previous de-escalation) — a flapping
+   signal can ratchet the ladder up but can never oscillate it, and two
+   rungs can never be descended within one healthy window.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests skip where hypothesis isn't baked in
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from fairness_llm_tpu.config import OverloadConfig
+from fairness_llm_tpu.serving.overload import ShedController
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.telemetry.registry import get_registry
+
+CFG = OverloadConfig(
+    enabled=True, burn_threshold=2.0, queue_frac_threshold=0.5,
+    queue_window_s=1.0, healthy_window_s=3.0, eval_interval_s=0.0,
+)
+
+# One operation: set the fast-window burn gauge, sample a queue depth,
+# advance the fake clock, or run one controller evaluation.
+OPS = st.lists(
+    st.one_of(
+        st.tuples(st.just("burn"),
+                  st.floats(min_value=0.0, max_value=10.0,
+                            allow_nan=False)),
+        st.tuples(st.just("depth"),
+                  st.integers(min_value=0, max_value=100)),
+        st.tuples(st.just("tick"),
+                  st.floats(min_value=0.05, max_value=2.0,
+                            allow_nan=False)),
+        st.tuples(st.just("eval"), st.just(0)),
+    ),
+    max_size=200,
+)
+
+
+@settings(max_examples=100, deadline=None)
+@given(ops=OPS)
+def test_level_moves_one_rung_with_the_signal(ops):
+    clock = {"t": 0.0}
+    with use_registry():
+        ctl = ShedController(CFG, clock=lambda: clock["t"])
+        # Arm the burn signal (it is presence-gated); long tick sequences
+        # age the presence out mid-run, which the oracle handles.
+        ctl.note_interactive()
+        burn_gauge = get_registry().gauge(
+            "slo_burn_rate", component="serving", slo="error_rate",
+            window="fast",
+        )
+        for op, val in ops:
+            if op == "burn":
+                burn_gauge.set(val)
+            elif op == "depth":
+                ctl.observe_queue_depth(val, capacity=100)
+            elif op == "tick":
+                clock["t"] += val
+            else:
+                hot = ctl.overloaded() is not None  # pure read, no state
+                before = ctl.level
+                after = ctl.evaluate()
+                assert 0 <= after <= 3
+                assert abs(after - before) <= 1, (
+                    f"level jumped {before} -> {after}"
+                )
+                if after > before:
+                    assert hot, "escalated without a hot signal"
+                if after < before:
+                    assert not hot, "de-escalated while a signal was hot"
+                if hot:
+                    assert after >= before, "moved down against the signal"
+
+
+@settings(max_examples=100, deadline=None)
+@given(ops=OPS)
+def test_hysteresis_gates_every_descent(ops):
+    clock = {"t": 0.0}
+    with use_registry():
+        ctl = ShedController(CFG, clock=lambda: clock["t"])
+        # Arm the burn signal (it is presence-gated); long tick sequences
+        # age the presence out mid-run, which the oracle handles.
+        ctl.note_interactive()
+        burn_gauge = get_registry().gauge(
+            "slo_burn_rate", component="serving", slo="error_rate",
+            window="fast",
+        )
+        last_hot_eval = None  # newest evaluation that saw a hot signal
+        last_descent = None
+        for op, val in ops:
+            if op == "burn":
+                burn_gauge.set(val)
+            elif op == "depth":
+                ctl.observe_queue_depth(val, capacity=100)
+            elif op == "tick":
+                clock["t"] += val
+            else:
+                hot = ctl.overloaded() is not None
+                before = ctl.level
+                after = ctl.evaluate()
+                now = clock["t"]
+                if hot:
+                    last_hot_eval = now
+                if after < before:
+                    # The healthy window must have elapsed since BOTH the
+                    # last hot evaluation and the previous descent — the
+                    # per-rung restart that stops a sawtooth.
+                    for bound in (last_hot_eval, last_descent):
+                        if bound is not None:
+                            assert now - bound >= CFG.healthy_window_s, (
+                                f"descended {now - bound:.2f}s after "
+                                "activity, inside the healthy window"
+                            )
+                    last_descent = now

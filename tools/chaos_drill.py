@@ -28,7 +28,14 @@ What it does, in one process, deterministically:
    token-identical to the single-engine greedy baseline, the healthy
    replica serving throughout, and the killed replica rejoining through
    its canary warm-up probe (``fleet_healthy_replicas`` back to 2);
-7. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
+7. drills OVERLOAD CONTROL (ISSUE 8): sheds a provably-doomed deadline at
+   admission (no prefill burned), then offers ~3x the queue's capacity
+   with mixed QoS classes — asserting interactive TTFT p95 holds its SLO
+   while batch sheds with explicit retry-after Results, zero
+   accepted-then-lost requests, nonzero ``shed_total`` counters, and the
+   controller de-escalating to level 0 after the flood
+   (``validate_telemetry --require-overload`` gates it);
+8. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
    lost), survivors token-for-token equal to the baseline (zero corrupt
    records — the NaN chunk was retried, not delivered), the breaker cycle
    + hang + numerics fault + manifest failure + canary mismatch + fleet
@@ -60,7 +67,7 @@ from fairness_llm_tpu.resilience import (  # noqa: E402
     resume_serving,
 )
 from fairness_llm_tpu.runtime.engine import DecodeEngine  # noqa: E402
-from fairness_llm_tpu.serving import ContinuousScheduler, Request  # noqa: E402
+from fairness_llm_tpu.serving import ContinuousScheduler, Request, Result  # noqa: E402
 from fairness_llm_tpu.utils.failures import ScriptedFaultInjector  # noqa: E402
 
 GREEDY = ModelSettings(temperature=0.0, max_tokens=8)
@@ -295,6 +302,114 @@ def main() -> int:
     check(fleet.last_failover_s is not None,
           f"failover recovery measured ({fleet.last_failover_s and round(fleet.last_failover_s, 4)}s "
           "fence -> first migrated token)")
+
+    # 7. Overload brownout (ISSUE 8): offer ~3x the queue's capacity with
+    # mixed QoS classes. The shed controller must walk the brownout ladder
+    # (batch sheds with explicit retry-after Results), interactive traffic
+    # must keep flowing inside its TTFT SLO, no accepted request may be
+    # lost, and the controller must de-escalate to level 0 after the flood.
+    from fairness_llm_tpu.config import OverloadConfig  # noqa: E402
+    from fairness_llm_tpu.telemetry.slo import (  # noqa: E402
+        SLOTargets,
+        set_slo_targets,
+    )
+
+    # Harness-appropriate SLO targets: a tiny CPU model meets 60 s TTFT
+    # trivially, so the drill's escalation signal is the deterministic one
+    # (queue depth), not compile-time TTFT outliers.
+    set_slo_targets(SLOTargets(ttft_p95_s=60.0, e2e_p99_s=120.0))
+    ov = OverloadConfig(
+        enabled=True, queue_frac_threshold=0.75, queue_window_s=0.5,
+        healthy_window_s=0.05, eval_interval_s=0.0, batch_token_cap=4,
+        retry_after_s=0.25,
+    )
+    ov_serving = ServingConfig(enabled=True, num_slots=2, queue_capacity=12,
+                               max_prompt_len=192, max_new_tokens=32,
+                               decode_chunk=4)
+    ov_sched = ContinuousScheduler(engine, ov_serving, settings=GREEDY,
+                                   overload=ov)
+
+    # 7a. Deadline-feasibility admission: with six requests stacked ahead
+    # on two slots, a 1 ms deadline is provably unmeetable — the gate must
+    # shed it AT SUBMIT (no prefill burned, no expiry later), using the
+    # prefill/cadence telemetry the earlier sections populated.
+    warm = [Request(prompt=p, id=f"ov_warm_{i}", settings=GREEDY)
+            for i, p in enumerate(list(PROMPTS.values())[:6])]
+    for r in warm:
+        assert ov_sched.submit(r)
+    doomed = Request(prompt=PROMPTS["ok0"], id="ov_doomed", settings=GREEDY,
+                     deadline_s=0.001)
+    accepted = ov_sched.submit(doomed)
+    doomed_res = ov_sched.take_result("ov_doomed")
+    check(not accepted and doomed_res is not None
+          and doomed_res.finish_reason == "shed"
+          and bool(doomed_res.retry_after_s)
+          and "unmeetable" in (doomed_res.error or ""),
+          "provably-doomed deadline shed at admission with retry-after "
+          f"({doomed_res and doomed_res.error})")
+    ov_sched.drain()
+    warm_ok = all((ov_sched.take_result(r.id) or Result(id=r.id, ok=False)).ok
+                  for r in warm)
+    check(warm_ok and ov_sched.shed_controller.level == 0,
+          "under-capacity warmup served clean at overload level 0")
+
+    # 7b. The flood: 30 batch + 6 interactive (3x the 12-deep queue), batch
+    # first — the starvation scenario.
+    base_prompts = list(PROMPTS.values())
+    flood = [Request(prompt=base_prompts[i % len(base_prompts)],
+                     id=f"ov_batch_{i:03d}", settings=GREEDY, qos="batch")
+             for i in range(30)]
+    flood += [Request(prompt=base_prompts[i % len(base_prompts)],
+                      id=f"ov_int_{i}", settings=GREEDY, qos="interactive")
+              for i in range(6)]
+    flood_results = {r.id: r for r in ov_sched.serve(flood)}
+    check(set(flood_results) == {r.id for r in flood},
+          "overload flood: every request got a terminal Result")
+    interactive = [flood_results[f"ov_int_{i}"] for i in range(6)]
+    check(all(r.ok for r in interactive),
+          "all interactive requests served through the flood")
+    ttfts = sorted(r.ttft_s for r in interactive if r.ttft_s is not None)
+    ttft_p95 = ttfts[min(len(ttfts) - 1, int(0.95 * len(ttfts)))] \
+        if ttfts else None
+    check(ttft_p95 is not None and ttft_p95 <= 60.0,
+          f"interactive TTFT p95 ({ttft_p95 and round(ttft_p95, 3)}s) holds "
+          "its SLO during the flood")
+    batch_res = [flood_results[f"ov_batch_{i:03d}"] for i in range(30)]
+    shed = [r for r in batch_res if r.finish_reason == "shed"]
+    served_batch = [r for r in batch_res if r.finish_reason != "shed"]
+    check(bool(shed) and all(r.retry_after_s for r in shed),
+          f"{len(shed)} batch request(s) shed with explicit retry-after")
+    check(all(r.ok for r in served_batch),
+          f"zero accepted-then-lost: all {len(served_batch)} admitted batch "
+          "requests terminal ok")
+    parity_ov = True
+    for r in interactive + served_batch:
+        prompt = next(q.prompt for q in flood if q.id == r.id)
+        ref = next(baseline[rid] for rid, p in PROMPTS.items() if p == prompt)
+        n = len(r.tokens)
+        if n == 0 or not np.array_equal(np.asarray(r.tokens), ref[:n]):
+            parity_ov = False
+            print(f"  overload parity break: {r.id}")
+    check(parity_ov, "admitted requests token-for-token with baseline "
+                     "across classes and shed/restore cycles")
+    reg = T.get_registry()
+    shed_batch = reg.read_value("shed_total", component="serving",
+                                **{"class": "batch", "reason": "overload"})
+    shed_doomed = reg.read_value("shed_total", component="serving",
+                                 **{"class": "interactive",
+                                    "reason": "deadline_infeasible"})
+    check(shed_batch > 0 and shed_doomed > 0,
+          f"shed_total counters nonzero (overload={shed_batch:g}, "
+          f"deadline_infeasible={shed_doomed:g})")
+    import time as _time
+    ctl = ov_sched.shed_controller
+    deadline = _time.monotonic() + 10.0
+    while ctl.level > 0 and _time.monotonic() < deadline:
+        ctl.evaluate()
+        _time.sleep(0.02)
+    check(ctl.level == 0 and reg.read_value(
+              "overload_level", component="serving") == 0,
+          "shed controller de-escalated to level 0 after the flood")
 
     snap = T.snapshot(T.get_registry())
     # Unlabeled entries only: the fleet section's per-replica boards write

@@ -30,6 +30,10 @@ terminal Result), and ``fleet_healthy_replicas`` back to
 ``achieved_over_achievable`` roofline gauge, a nonzero ``step_gap_s``
 histogram, and a schema-valid ``trace.json`` beside the snapshot
 containing prefill + decode spans and request lanes.
+``--require-overload`` requires the overload-control signals the brownout
+drill produces (ISSUE 8): nonzero ``shed_total``, an
+``overload_transitions_total`` escalation AND a return to level 0, and
+every ``overload_level`` gauge ending at 0.
 """
 
 from __future__ import annotations
@@ -49,11 +53,47 @@ def check(path: str, require_serving: bool = False,
           require_breaker: bool = False,
           require_integrity: bool = False,
           require_fleet: bool = False,
-          require_profile: bool = False) -> int:
+          require_profile: bool = False,
+          require_overload: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
     if require_profile:
         problems.extend(_check_profile(path, snap))
+    if require_overload:
+        counters = snap.get("counters", [])
+
+        def total(name):
+            return sum(c["value"] for c in counters if c.get("name") == name)
+
+        if not total("shed_total"):
+            problems.append(
+                "shed_total is zero (overload control never shed anything)"
+            )
+        trans = [c for c in counters
+                 if c.get("name") == "overload_transitions_total"]
+        if not any(c["value"] for c in trans
+                   if c.get("labels", {}).get("to") not in (None, "0")):
+            problems.append(
+                "no overload transition to a nonzero level (the brownout "
+                "ladder never escalated)"
+            )
+        if not any(c["value"] for c in trans
+                   if c.get("labels", {}).get("to") == "0"):
+            problems.append(
+                "no overload transition back to level 0 (the controller "
+                "never de-escalated)"
+            )
+        levels = [g for g in snap.get("gauges", [])
+                  if g.get("name") == "overload_level"]
+        if not levels:
+            problems.append("no overload_level gauge (overload control "
+                            "never armed)")
+        for g in levels:
+            if g["value"] != 0:
+                problems.append(
+                    f"overload_level {g.get('labels', {})} ended at "
+                    f"{g['value']:g} (controller did not return to 0)"
+                )
     if require_fleet:
         counters = snap.get("counters", [])
 
@@ -215,12 +255,14 @@ def main() -> int:
     ap.add_argument("--require-integrity", action="store_true")
     ap.add_argument("--require-fleet", action="store_true")
     ap.add_argument("--require-profile", action="store_true")
+    ap.add_argument("--require-overload", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
                  require_integrity=a.require_integrity,
                  require_fleet=a.require_fleet,
-                 require_profile=a.require_profile)
+                 require_profile=a.require_profile,
+                 require_overload=a.require_overload)
 
 
 if __name__ == "__main__":
