@@ -246,6 +246,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the copy the validator and --timeline report "
                         "read); this flag adds an extra copy at PATH, or "
                         "enables the export without a telemetry dir")
+    p.add_argument("--fairness-obs", action="store_true",
+                   help="fairness observability (telemetry/fairness.py): "
+                        "phases register their profile grid with the "
+                        "fairness monitor, sweep requests carry "
+                        "group/attribute/pair_id study tags, and the run "
+                        "records streaming per-group DP/IF/exposure "
+                        "gauges, a counterfactual pair watch with "
+                        "serving-event attribution, and a serving-"
+                        "neutrality audit (per-group TTFT/queue-wait/"
+                        "shed/fault disparity, alerting via "
+                        "fairness_alerts_total). Render with "
+                        "`fairness-report <telemetry-dir>`; gate with "
+                        "tools/validate_telemetry.py --require-fairness. "
+                        "See docs/OBSERVABILITY.md §Fairness signals")
     p.add_argument("--slo-ttft-p95", type=float, default=None, metavar="S",
                    help="SLO target: p95 time-to-first-token in seconds "
                         "(default 2.0); burn rates exported as "
@@ -287,10 +301,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["telemetry_dir"] = args.telemetry_dir
     attribution_flags = (args.trace_out, args.slo_ttft_p95, args.slo_e2e_p99,
                          args.slo_error_rate, args.achievable_gbps)
-    if any(v is not None for v in attribution_flags):
+    if args.fairness_obs or any(v is not None for v in attribution_flags):
         from fairness_llm_tpu.config import TelemetryConfig
 
         tel_kwargs: Dict = {}
+        if args.fairness_obs:
+            tel_kwargs["fairness_obs"] = True
         if args.trace_out:
             tel_kwargs["trace_out"] = args.trace_out
         if args.achievable_gbps is not None:
@@ -482,6 +498,16 @@ def telemetry_report(argv) -> int:
                 print(f"  - {p}")
             return 1
     print(render_report(snap))
+    if any(row.get("labels", {}).get("component") == "fairness"
+           for section in ("counters", "gauges")
+           for row in snap.get(section, [])):
+        # Fairness section rides along whenever the run recorded fairness
+        # instruments (--fairness-obs / tagged requests); the standalone
+        # `fairness-report` subcommand adds the divergent-pair table from
+        # events.jsonl.
+        from fairness_llm_tpu.telemetry import render_fairness_report
+
+        print("\n" + render_fairness_report(snap))
     if a.timeline:
         trace_dir = a.path if os.path.isdir(a.path) else os.path.dirname(a.path)
         trace_path = os.path.join(trace_dir, TRACE_FILENAME)
@@ -525,6 +551,52 @@ def slo_report(argv) -> int:
         ]
         if burning:
             print(f"\n{len(burning)} SLO(s) burning over the whole run")
+            return 1
+    return 0
+
+
+def fairness_report(argv) -> int:
+    """``cli fairness-report <dir|snapshot.json>`` — render the fairness
+    signals a run recorded (telemetry/fairness.py): streaming vs offline
+    DP/IF/exposure, the per-group neutrality audit, disparity gauges with
+    alert counts, and the divergent-pair attribution table (joined from
+    ``events.jsonl`` when rendering a telemetry dir). See
+    docs/OBSERVABILITY.md §Fairness signals."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu fairness-report",
+        description="Render fairness observability from a telemetry "
+                    "snapshot",
+    )
+    ap.add_argument("path", help="telemetry dir (uses telemetry_snapshot."
+                                 "json + events.jsonl inside) or a "
+                                 "snapshot file")
+    ap.add_argument("--fail-on-alert", action="store_true",
+                    help="exit non-zero when any fairness_alerts_total is "
+                         "nonzero or any pair diverged (a CI gate)")
+    a = ap.parse_args(argv)
+    import os
+
+    from fairness_llm_tpu.telemetry import (
+        load_snapshot,
+        read_events,
+        render_fairness_report,
+    )
+
+    snap = load_snapshot(a.path)
+    events = None
+    ev_dir = a.path if os.path.isdir(a.path) else os.path.dirname(a.path)
+    ev_path = os.path.join(ev_dir, "events.jsonl")
+    if os.path.exists(ev_path):
+        events = read_events(ev_path)
+    print(render_fairness_report(snap, events=events))
+    if a.fail_on_alert:
+        alerts = sum(c["value"] for c in snap.get("counters", [])
+                     if c.get("name") == "fairness_alerts_total")
+        diverged = sum(c["value"] for c in snap.get("counters", [])
+                       if c.get("name") == "fairness_pair_divergence_total")
+        if alerts or diverged:
+            print(f"\n{int(alerts)} fairness alert(s), {int(diverged)} "
+                  "divergent pair(s)")
             return 1
     return 0
 
@@ -644,6 +716,8 @@ def main(argv=None) -> int:
         return telemetry_report(argv[1:])
     if argv and argv[0] == "slo-report":
         return slo_report(argv[1:])
+    if argv and argv[0] == "fairness-report":
+        return fairness_report(argv[1:])
     if argv and argv[0] == "resume-serving":
         return resume_serving_cmd(argv[1:])
     args = build_parser().parse_args(argv)

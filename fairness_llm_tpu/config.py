@@ -275,6 +275,13 @@ class TelemetryConfig:
     # (None = platform default: 819 GB/s v5e spec on TPU, a nominal DDR
     # figure on the CPU harness — indicative only).
     achievable_gbps: Optional[float] = None
+    # Fairness observability (telemetry/fairness.py, CLI --fairness-obs):
+    # phases register their profile grid + counterfactual pairs with the
+    # fairness monitor, sweep requests carry group/attribute/pair_id tags,
+    # and the streaming DP/IF/exposure gauges + serving-neutrality audit +
+    # pair watch record live. Off by default: the monitor stays idle and
+    # every hook is a dict miss. See docs/OBSERVABILITY.md §Fairness.
+    fairness_obs: bool = False
     slo_ttft_p95_s: float = 2.0
     slo_e2e_p99_s: float = 30.0
     slo_error_rate: float = 0.01

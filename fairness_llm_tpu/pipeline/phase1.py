@@ -40,7 +40,13 @@ from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
 from fairness_llm_tpu.pipeline.parsing import canonicalize, parse_numbered_list
 from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
-from fairness_llm_tpu.telemetry import Heartbeat, get_registry
+from fairness_llm_tpu.telemetry import (
+    Heartbeat,
+    get_fairness_monitor,
+    get_registry,
+    group_exposure,
+    publish_offline_reference,
+)
 from fairness_llm_tpu.utils.progress import print_progress
 
 logger = logging.getLogger(__name__)
@@ -98,11 +104,22 @@ def decode_sweep(
             keys=[k for k, _ in batch],
             prefix_ids=prefix_ids,
         )
+        mon = get_fairness_monitor()
         for (k, _), text in zip(batch, texts):
             if text is None:  # contained decode failure — see utils/failures.py
                 done[k] = {"recommendations": [], "raw_response": "", "error": "decode_failed"}
             else:
                 done[k] = {"recommendations": parse(text), "raw_response": text}
+            if mon.active:
+                # Streaming fairness accumulators (telemetry/fairness.py):
+                # the content side of the pair watch + the per-group
+                # DP/IF/exposure folds. Error entries stream too — the
+                # offline metrics include their empty rec lists, and the
+                # live gauges must match them at end of run.
+                mon.observe_output(k, done[k]["recommendations"],
+                                   error="error" in done[k])
+        if mon.active:
+            mon.maybe_refresh()
         completed = len(done)
         if save_checkpoints and config.checkpoint_every and (
             completed % config.checkpoint_every < chunk or start + chunk >= len(keys)
@@ -121,6 +138,17 @@ def decode_sweep(
         # A resume whose tail chunks were all cached leaves the bar mid-line;
         # finish it so subsequent stderr output starts on a fresh line.
         print_progress(len(keys), len(keys), prefix=f"{phase} ")
+    mon = get_fairness_monitor()
+    if mon.active:
+        # Backfill entries the stream never saw (a resume's cached
+        # checkpoint rows) — observe_output dedups, so streamed keys
+        # no-op and the run-window accumulators cover exactly the
+        # returned result set — then refresh the derived gauges.
+        for k in keys:
+            if k in done:
+                mon.observe_output(k, done[k]["recommendations"],
+                                   error="error" in done[k])
+        mon.refresh()
     return {k: done[k] for k in keys if k in done}
 
 
@@ -165,6 +193,27 @@ def measure_equal_opportunity(
     return M.equal_opportunity(
         canon_groups, set(canonicalize(sorted(qualified))), group_counts_fn
     )
+
+
+def register_fairness_study(profiles: Sequence[Profile]):
+    """Arm the fairness monitor (telemetry/fairness.py) for one sweep:
+    every profile's group memberships and the full counterfactual pair
+    grid. Serving requests then carry the tags (``ServingBackend`` stamps
+    them), the scheduler's terminal paths feed the neutrality audit, and
+    ``decode_sweep``'s parse step feeds the streaming DP/IF/exposure
+    accumulators — whose end-of-run values the offline metrics below
+    cross-check. Returns the monitor."""
+    mon = get_fairness_monitor()
+    mon.begin_study()
+    by_id = {p.id: p for p in profiles}
+    for p in profiles:
+        mon.register_request(p.id, {"gender": p.gender, "age": p.age})
+    for a, b in profile_pairs(profiles):
+        pa, pb = by_id[a], by_id[b]
+        attr = next(x for x in ("gender", "age", "occupation")
+                    if getattr(pa, x) != getattr(pb, x))
+        mon.register_pair(f"{a}|{b}", a, b, attr)
+    return mon
 
 
 def qualified_movies(data, top_n: int = 10, seed: int = 42) -> List[str]:
@@ -212,6 +261,10 @@ def run_phase1(
         for k in neutral_keys
     ]
     neutral_prompts = [recommendation_prompt(p, anonymize=True) for p in neutral_profiles]
+
+    mon = None
+    if config.telemetry.fairness_obs:
+        mon = register_fairness_study(profiles)
 
     if hasattr(backend, "spec_totals"):
         # Reused/injected backends may carry speculation counters from
@@ -270,6 +323,27 @@ def run_phase1(
     }
     snsr_age, snsv_age, sns_sims_age = M.snsr_snsv(neutral_flat, recs_by_age_flat)
 
+    # Fairness observability cross-check (telemetry/fairness.py): publish
+    # the OFFLINE scores as fairness_offline_* gauges so `validate_telemetry
+    # --require-fairness` can assert the streaming gauges match them to fp
+    # tolerance, and carry both sides in the result metadata below.
+    fairness_block = None
+    if mon is not None and mon.active:
+        expo_gender, _ = group_exposure(by_gender)
+        expo_age, _ = group_exposure(by_age)
+        publish_offline_reference(
+            {"gender": dp_gender, "age": dp_age}, if_score=if_score,
+            exposure={"gender": expo_gender, "age": expo_age},
+        )
+        fairness_block = {
+            "live": mon.live_values(),
+            "offline": {
+                "dp": {"gender": dp_gender, "age": dp_age},
+                "individual_fairness": if_score,
+                "exposure_ratio": {"gender": expo_gender, "age": expo_age},
+            },
+        }
+
     elapsed = time.time() - t0
     # Phase-level telemetry (component="phase1"): wall-time distribution
     # across runs of this process plus decode-failure visibility; the
@@ -318,6 +392,11 @@ def run_phase1(
                 backend.serve_totals.as_dict()
                 if getattr(backend, "serve_totals", None) is not None else None
             ),
+            # fairness-observability snapshot: the streaming gauges' end-of-
+            # run values beside the offline scores — the live-vs-offline
+            # cross-check this study artifact carries (None when
+            # --fairness-obs was off)
+            "fairness": fairness_block,
         },
         "profiles": [p.to_dict() for p in profiles],
         "recommendations": {

@@ -322,6 +322,24 @@ def run_phase3(
         backend = backend_for(model_name, config, catalog=catalog)
     settings = config.settings_for(model_name) if model_name != "simulated" else None
 
+    if config.telemetry.fairness_obs:
+        # Fairness observability (telemetry/fairness.py): arm the monitor
+        # so the MITIGATED sweep's requests carry study tags — but only
+        # when no study is already live. In an --all run phase 1 armed it
+        # and published its offline reference gauges; re-registering here
+        # would overwrite the run-window gauges with the mitigated sweep's
+        # values while the stale phase-1 fairness_offline_* gauges remain
+        # (gauges persist in the registry), making the live-vs-offline
+        # cross-check fail spuriously on a healthy run. Phase 3's sweep
+        # reuses the same profile ids, so the existing registration keeps
+        # tagging its requests for the neutrality audit, and the content
+        # dedup keeps the accumulators pinned to phase 1's result set.
+        from fairness_llm_tpu.pipeline.phase1 import register_fairness_study
+        from fairness_llm_tpu.telemetry import get_fairness_monitor
+
+        if not get_fairness_monitor().active:
+            register_fairness_study(profiles)
+
     # --- mitigation
     mitigated = apply_facter(
         profiles, backend, config, strategy, variant, settings,

@@ -86,6 +86,12 @@ class ServingJournal:
             # stay batch (or it would jump the interactive sub-queue and
             # dodge the brownout ladder in the successor process).
             "qos": getattr(request, "qos", "interactive"),
+            # Study tags survive too (telemetry/fairness.py): the resumed
+            # request must keep its group identity or the successor
+            # process's neutrality audit would see untagged traffic.
+            "group": getattr(request, "group", None),
+            "attribute": getattr(request, "attribute", None),
+            "pair_id": getattr(request, "pair_id", None),
             "settings": dataclasses.asdict(s) if s is not None else None,
             "ts_unix": time.time(),
         })
@@ -195,6 +201,8 @@ class ServingJournal:
                 # Pre-QoS journals have no field; interactive is the
                 # Request default those runs were implicitly serving as.
                 qos=spec.get("qos", "interactive"),
+                group=spec.get("group"), attribute=spec.get("attribute"),
+                pair_id=spec.get("pair_id"),
             ))
         return out
 

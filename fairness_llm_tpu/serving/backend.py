@@ -287,6 +287,13 @@ class ServingBackend:
             return list(out.texts)
         sched = self.scheduler_for(settings)
         self._maybe_canary(sched)
+        # Study tags (telemetry/fairness.py): a phase that registered its
+        # profile grid with the fairness monitor gets its sweep requests
+        # stamped with (attribute, group, pair_id), so the serving layer's
+        # treatment of each demographic group is observable per request.
+        from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
+
+        mon = get_fairness_monitor()
         requests = []
         for i, p in enumerate(prompts):
             if keys is not None:
@@ -295,6 +302,7 @@ class ServingBackend:
                 rid, row_seed = keys[i], (_stable_hash(keys[i]) ^ seed) & 0xFFFFFFFF
             else:
                 rid, row_seed = f"call{seed}_{i:05d}", (seed * 1_000_003 + i) & 0xFFFFFFFF
+            tags = mon.request_tags(rid) if mon.active else None
             requests.append(Request(
                 prompt=p, id=rid, settings=settings, row_seed=row_seed,
                 # Phase sweeps are throughput traffic: the class a
@@ -302,6 +310,9 @@ class ServingBackend:
                 # resumable-sentinel convention, so a shed sweep row is
                 # retried by the pipeline's containment, not lost).
                 qos="batch",
+                attribute=tags[0] if tags else None,
+                group=tags[1] if tags else None,
+                pair_id=tags[2] if tags else None,
             ))
         results = sched.serve(requests)
         stats = sched.last_stats

@@ -83,6 +83,7 @@ from fairness_llm_tpu.serving.request import QOS_CLASSES, QOS_PRIORITY, Request,
 from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
@@ -327,6 +328,10 @@ class ReplicaSet:
             **self._fleet_labels,
         ).inc()
         self._shed_fleet += 1
+        # A fleet-intake shed is exactly the group-unequal treatment the
+        # neutrality audit must see — no replica scheduler will ever
+        # observe this request.
+        get_fairness_monitor().observe_request(req, "shed")
         if journaled and self.journal is not None:
             self.journal.record_terminal(req.id, "shed")
         self._deliver(req.id, Result(
@@ -689,6 +694,16 @@ class ReplicaSet:
             # fresh retry budget (per-replica fault domain — its requeue
             # was spent on a replica now out of the fleet).
             req.retries = 0
+            # Pair-watch attribution (telemetry/fairness.py): a tagged
+            # request's migration — and which replica it fled — shows up
+            # in the divergent-pair table (tagged= because a direct-tagged
+            # request's pairs only register at terminal time, and the
+            # migration also resets retries, so nothing else would record
+            # the event).
+            get_fairness_monitor().note_event(
+                rid, f"migrated:{rep.name}",
+                tagged=(req.group is not None or req.pair_id is not None),
+            )
             self._migrating.append(req)
             migrated += 1
             if rid not in self._migrated_ids:

@@ -66,6 +66,15 @@ class Request:
     strict-priority-with-aging dequeue, and the shed controller's brownout
     ladder rejects lower classes first. Without overload control every
     class is served FIFO exactly as before.
+
+    ``group``/``attribute``/``pair_id`` are optional STUDY tags
+    (``telemetry/fairness.py``): which demographic group of which
+    sensitive attribute this request's prompt represents, and — for the
+    counterfactual pair watch — which pair it is a member of. Tags change
+    nothing about scheduling; they let the fairness monitor break serving
+    treatment (TTFT, queue wait, sheds, faults) down per group and join
+    pair members as they complete. The journal persists them, so a
+    drained study request resumes with its group identity intact.
     """
 
     prompt: str
@@ -76,6 +85,9 @@ class Request:
     submitted_at: float = dataclasses.field(default_factory=time.monotonic)
     retries: int = 0  # scheduler-owned: requeue count after faults
     qos: str = "interactive"
+    group: Optional[str] = None
+    attribute: Optional[str] = None
+    pair_id: Optional[str] = None
 
     def __post_init__(self) -> None:
         if self.qos not in QOS_CLASSES:

@@ -112,6 +112,7 @@ from fairness_llm_tpu.telemetry import (
     get_registry,
 )
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
 from fairness_llm_tpu.telemetry.roofline import observe_decode
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.integrity.numerics import check_finite, masked_finite
@@ -821,6 +822,19 @@ class ContinuousScheduler:
                 kept.append(req)
         self._pending = kept
 
+    def _note_fairness(self, request: Request, outcome: str, row,
+                       text: str = "") -> None:
+        """Feed the fairness monitor's serving side (telemetry/fairness.py)
+        at every terminal outcome: per-group neutrality audit + the pair
+        watch's outcome/attribution half. A no-op for untagged traffic
+        (the monitor early-returns on a dict miss)."""
+        get_fairness_monitor().observe_request(
+            request, outcome, queue_wait_s=row.queue_wait_s,
+            ttft_s=row.ttft_s, text=text, replica=self.replica,
+            rung=(self.breakers.ladder.level
+                  if self.breakers is not None else 0),
+        )
+
     def _fail(self, request: Request, reason: str, error: str,
               stats: ServingStats, tokens: Optional[List[int]] = None) -> None:
         tok = self.engine.tokenizer
@@ -828,6 +842,7 @@ class ContinuousScheduler:
         text = tok.decode([t for t in ids if t != tok.eos_id])
         outcome = "expired" if reason == "deadline" else "failed"
         row = self.tracer.finalize(request.id, outcome, tokens=len(ids))
+        self._note_fairness(request, outcome, row, text=text)
         self._results[request.id] = Result(
             id=request.id, ok=False, text=text,
             tokens=np.asarray(ids, np.int32), finish_reason=reason,
@@ -856,6 +871,7 @@ class ContinuousScheduler:
             self.tracer.record(request.id, "submitted",
                                t=request.submitted_at)
         row = self.tracer.finalize(request.id, "shed", tokens=0)
+        self._note_fairness(request, "shed", row)
         self._results[request.id] = Result(
             id=request.id, ok=False, finish_reason="shed", error=error,
             retries=request.retries,
@@ -952,6 +968,15 @@ class ContinuousScheduler:
                 cause=cause, **self.labels,
             ).inc()
             self.tracer.record(request.id, "requeued")
+            # Pair-watch attribution: a tagged request's requeue (and its
+            # cause) shows up in the divergent-pair table. tagged= covers
+            # direct-tagged requests whose pairs only auto-register at
+            # terminal time — after this requeue.
+            get_fairness_monitor().note_event(
+                request.id, f"requeued:{cause}",
+                tagged=(request.group is not None
+                        or request.pair_id is not None),
+            )
             self.queue.requeue(request)
         else:
             self._fail(request, "failed", error, stats)
@@ -971,6 +996,7 @@ class ContinuousScheduler:
                        stats, tokens=ids)
             return
         row = self.tracer.finalize(req.id, "completed", tokens=len(ids))
+        self._note_fairness(req, "completed", row, text=text)
         self._results[req.id] = Result(
             id=req.id, ok=True, text=text,
             tokens=np.asarray(ids, np.int32), finish_reason=reason,

@@ -77,6 +77,15 @@ from fairness_llm_tpu.telemetry.timeline import (
     validate_chrome_trace,
 )
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.fairness import (
+    FairnessMonitor,
+    get_fairness_monitor,
+    group_exposure,
+    publish_offline_reference,
+    render_fairness_report,
+    set_fairness_monitor,
+    use_fairness_monitor,
+)
 from fairness_llm_tpu.telemetry.roofline import (
     decode_step_bytes,
     observe_decode,
@@ -176,6 +185,13 @@ __all__ = [
     "summarize_chrome_trace",
     "note_lookup",
     "record_compile",
+    "FairnessMonitor",
+    "get_fairness_monitor",
+    "set_fairness_monitor",
+    "use_fairness_monitor",
+    "group_exposure",
+    "publish_offline_reference",
+    "render_fairness_report",
     "decode_step_bytes",
     "observe_decode",
     "reference_achievable_gbps",

@@ -1,0 +1,437 @@
+"""Fairness-observability tests (telemetry/fairness.py, ISSUE 9).
+
+Covers the three instruments — streaming group accumulators (end-of-run
+equality with the offline metrics), the counterfactual pair watch
+(join rules, divergence verdicts, serving-event attribution), and the
+serving-neutrality audit (disparity gauges + alert machinery) — plus the
+edge cases the ISSUE names: empty-group NaN discipline, single-member
+pairs that never join, window aging, and label isolation across
+attributes. Serving-side tests run the real ContinuousScheduler on the
+tiny CPU engine; journal tests pin the study-tag persistence contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import ModelSettings, ServingConfig
+from fairness_llm_tpu.metrics.fairness import (
+    demographic_parity,
+    individual_fairness,
+)
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import ContinuousScheduler, Request
+from fairness_llm_tpu.telemetry import use_registry, write_snapshot
+from fairness_llm_tpu.telemetry.fairness import (
+    FairnessMonitor,
+    group_exposure,
+    publish_offline_reference,
+    render_fairness_report,
+    use_fairness_monitor,
+)
+from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+GREEDY = ModelSettings(temperature=0.0, max_tokens=8)
+SCFG = ServingConfig(
+    enabled=True, num_slots=2, queue_capacity=64,
+    max_prompt_len=192, max_new_tokens=32, decode_chunk=4,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+RECS = {
+    "a0": ["X", "Y", "Z"], "a1": ["X", "Q"],
+    "b0": ["Y", "Z"], "b1": ["W", "X", "Y", "Q"],
+}
+GROUPS = {"a0": "g1", "a1": "g1", "b0": "g2", "b1": "g2"}
+PAIRS = [("a0", "b0"), ("a1", "b1")]
+
+
+def _feed_study(mon, recs=RECS, groups=GROUPS, pairs=PAIRS,
+                errors=()):
+    mon.begin_study()
+    for k, g in groups.items():
+        mon.register_request(k, {"gender": g})
+    for i, (a, b) in enumerate(pairs):
+        mon.register_pair(f"p{i}", a, b, "gender")
+    for k, r in recs.items():
+        mon.observe_output(k, r, error=(k in errors))
+    mon.refresh()
+
+
+# -- streaming accumulators vs offline metrics --------------------------------
+
+
+def test_streaming_matches_offline():
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        _feed_study(mon)
+        by_group = {"g1": [RECS["a0"], RECS["a1"]],
+                    "g2": [RECS["b0"], RECS["b1"]]}
+        off_dp, _ = demographic_parity(by_group)
+        off_if, sims = individual_fairness(PAIRS, RECS)
+        off_ex, _ = group_exposure(by_group)
+        live = lambda n, **lb: reg.read_value(n, component="fairness", **lb)
+        assert live("fairness_dp", attribute="gender",
+                    window="run") == pytest.approx(off_dp, abs=1e-6)
+        assert live("fairness_if", attribute="all",
+                    window="run") == pytest.approx(off_if, abs=1e-6)
+        assert live("fairness_exposure_ratio", attribute="gender",
+                    window="run") == pytest.approx(off_ex, abs=1e-6)
+        assert len(sims) == mon.pairs_joined == 2
+
+
+def test_observe_output_is_idempotent():
+    """The resume-backfill contract: re-offering a streamed key no-ops, so
+    the accumulators never double-count."""
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        _feed_study(mon)
+        before = reg.read_value("fairness_dp", component="fairness",
+                                attribute="gender", window="run")
+        for k, r in RECS.items():
+            mon.observe_output(k, r)  # second offer
+        mon.refresh()
+        after = reg.read_value("fairness_dp", component="fairness",
+                               attribute="gender", window="run")
+        assert after == before
+        assert mon.pairs_joined == 2  # pairs evaluate once
+
+
+def test_empty_group_nan_discipline():
+    """Empty demographic groups must never surface as NaN (the PR-5
+    allow_nan=False contract): DP over one live group is vacuously 1.0,
+    exposure excludes empty groups, IF with no joined pairs is 0.0."""
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        mon.begin_study()
+        mon.register_request("a0", {"gender": "g1"})
+        mon.register_request("b0", {"gender": "g2"})
+        mon.observe_output("a0", ["X", "Y"])
+        mon.observe_output("b0", [])  # decoded to nothing
+        mon.refresh()
+        vals = [
+            reg.read_value("fairness_dp", component="fairness",
+                           attribute="gender", window="run"),
+            reg.read_value("fairness_exposure_ratio", component="fairness",
+                           attribute="gender", window="run"),
+            reg.read_value("fairness_if", component="fairness",
+                           attribute="all", window="run", default=0.0),
+        ]
+        assert all(np.isfinite(v) for v in vals), vals
+        # One populated group: no comparable pair -> vacuous parity, and
+        # the empty group is excluded from the exposure ratio.
+        assert vals[0] == pytest.approx(1.0)
+        assert vals[1] == pytest.approx(1.0)
+
+
+def test_single_member_pair_never_joins():
+    """A pair whose second member never reports (shed before any content,
+    lost client, never submitted) must stay pending: not joined, excluded
+    from the IF mean, never counted divergent."""
+    with use_registry(), use_fairness_monitor() as mon:
+        mon.begin_study()
+        mon.register_request("a0", {"gender": "g1"})
+        mon.register_request("b0", {"gender": "g2"})
+        mon.register_pair("p0", "a0", "b0", "gender")
+        mon.observe_output("a0", ["X"])
+        mon.refresh()
+        assert mon.pairs_joined == 0
+        assert mon.pairs_divergent == 0
+        assert mon._if.get("__all__") is None
+
+
+def test_window_aging():
+    """The recent-window gauges age out old observations; the run-window
+    gauges keep them."""
+    t = [0.0]
+    with use_registry() as reg:
+        mon = FairnessMonitor(window_s=10.0, clock=lambda: t[0])
+        with use_fairness_monitor(mon):
+            mon.begin_study()
+            for k in ("a0", "a1"):
+                mon.register_request(k, {"gender": "g1"})
+            for k in ("b0", "b1"):
+                mon.register_request(k, {"gender": "g2"})
+            # Old epoch: groups differ maximally (disjoint rec sets).
+            mon.observe_output("a0", ["X", "Y"])
+            mon.observe_output("b0", ["P", "Q"])
+            mon.refresh()
+            run_0 = reg.read_value("fairness_dp", component="fairness",
+                                   attribute="gender", window="run")
+            t[0] = 100.0  # far past the window
+            # New epoch: groups identical (DP -> 1.0 over recent data).
+            mon.observe_output("a1", ["Z", "W"])
+            mon.observe_output("b1", ["Z", "W"])
+            mon.refresh()
+            recent = reg.read_value("fairness_dp", component="fairness",
+                                    attribute="gender", window="recent")
+            run_1 = reg.read_value("fairness_dp", component="fairness",
+                                   attribute="gender", window="run")
+            assert recent == pytest.approx(1.0)  # only the identical epoch
+            assert run_1 < 1.0  # the run window still sees the disjoint one
+            assert run_1 != run_0
+
+
+def test_label_isolation_across_attributes():
+    """Observations fold into their own attribute's instruments only:
+    construct data where gender distributions are identical (DP 1.0) but
+    age distributions are disjoint (DP well below 1)."""
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        mon.begin_study()
+        tags = {
+            "k0": {"gender": "m", "age": "young"},
+            "k1": {"gender": "f", "age": "young"},
+            "k2": {"gender": "m", "age": "old"},
+            "k3": {"gender": "f", "age": "old"},
+        }
+        recs = {"k0": ["A"], "k1": ["A"], "k2": ["B"], "k3": ["B"]}
+        for k, g in tags.items():
+            mon.register_request(k, g)
+        for k, r in recs.items():
+            mon.observe_output(k, r)
+        mon.refresh()
+        dp_gender = reg.read_value("fairness_dp", component="fairness",
+                                   attribute="gender", window="run")
+        dp_age = reg.read_value("fairness_dp", component="fairness",
+                                attribute="age", window="run")
+        # gender groups both hold {A: 1, B: 1}; age groups are disjoint.
+        assert dp_gender == pytest.approx(1.0, abs=1e-6)
+        assert dp_age < 0.6
+
+
+# -- serving-side: neutrality audit + pair watch ------------------------------
+
+
+def _tagged_requests(prompts, tag=""):
+    reqs = []
+    for i, p in enumerate(prompts):
+        for g in ("ga", "gb"):
+            reqs.append(Request(prompt=p, id=f"{tag}{g}{i}",
+                                settings=GREEDY, group=g, attribute="drill",
+                                pair_id=f"{tag}pp{i}"))
+    return reqs
+
+
+def test_fault_free_serving_is_silent(engine):
+    prompts = ["the quick brown fox", "hello there friend",
+               "one two three", "name five good books"]
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        mon.min_group_n = 3
+        sched = ContinuousScheduler(engine, SCFG, settings=GREEDY)
+        results = sched.serve(_tagged_requests(prompts))
+        assert all(r.ok for r in results)
+        assert mon.pairs_joined == len(prompts)
+        assert mon.pairs_divergent == 0
+        assert reg.read_value("fairness_alerts_total", component="fairness",
+                              attribute="drill",
+                              signal="impaired_rate") == 0
+        # Neutrality audit populated: per-group outcome counters and
+        # latency histograms exist for both groups.
+        for g in ("ga", "gb"):
+            assert reg.read_value("fairness_requests_total",
+                                  component="fairness", attribute="drill",
+                                  group=g, outcome="completed") == len(prompts)
+            h = reg.peek("fairness_ttft_s", component="fairness",
+                         attribute="drill", group=g)
+            assert h is not None and h.count == len(prompts)
+
+
+def test_biased_faults_alert_and_attribute(engine):
+    prompts = ["the quick brown fox", "hello there friend",
+               "one two three", "name five good books"]
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        mon.min_group_n = 3
+        inj = ScriptedFaultInjector(
+            faults={("gb0", "decode"): 2, ("gb1", "decode"): 2},
+        )
+        sched = ContinuousScheduler(engine, SCFG, settings=GREEDY,
+                                    fault_injector=inj)
+        results = {r.id: r for r in sched.serve(_tagged_requests(prompts))}
+        assert not results["gb0"].ok and not results["gb1"].ok
+        assert mon.pairs_divergent >= 2
+        assert reg.read_value("fairness_alerts_total", component="fairness",
+                              attribute="drill",
+                              signal="impaired_rate") >= 1
+        assert reg.read_value("fairness_disparity", component="fairness",
+                              attribute="drill",
+                              signal="impaired_rate") >= 0.25
+        # Attribution: the divergent pairs name the failed member's
+        # requeue events.
+        divergent = {d["pair_id"]: d for d in mon.divergent}
+        for pid in ("pp0", "pp1"):
+            members = divergent[pid]["members"]
+            bad = members[f"gb{pid[-1]}"]
+            assert bad["outcome"] == "failed"
+            assert any("requeued" in e for e in bad["events"])
+
+
+def test_identical_pair_content_divergence_counts(engine):
+    """Byte-identical pair members that produce different bytes (the
+    serving-corruption shape) count divergent with cause=content — while
+    different-prompt counterfactual members never do."""
+    with use_registry(), use_fairness_monitor() as mon:
+        sched = ContinuousScheduler(engine, SCFG, settings=GREEDY)
+        # Different prompts, same pair: legitimate counterfactual — the
+        # outputs differ but that is measurement, not an incident.
+        res = sched.serve([
+            Request(prompt="the quick brown fox", id="c0", settings=GREEDY,
+                    group="x", attribute="t", pair_id="cf"),
+            Request(prompt="hello there friend", id="c1", settings=GREEDY,
+                    group="y", attribute="t", pair_id="cf"),
+        ])
+        assert all(r.ok for r in res)
+        assert mon.pairs_joined == 1 and mon.pairs_divergent == 0
+        # Identical prompts with divergent row seeds under SAMPLED decode
+        # would differ; emulate via direct observe_request with different
+        # texts — the monitor sees identical prompts, different bytes.
+        mon2 = FairnessMonitor()
+        ra = Request(prompt="same", id="i0", group="x", attribute="t",
+                     pair_id="ip")
+        rb = Request(prompt="same", id="i1", group="y", attribute="t",
+                     pair_id="ip")
+        with use_registry():
+            mon2.observe_request(ra, "completed", text="alpha beta")
+            mon2.observe_request(rb, "completed", text="alpha GAMMA")
+            assert mon2.pairs_joined == 1
+            assert mon2.pairs_divergent == 1
+            assert mon2.divergent[0]["cause"] == "content"
+
+
+def test_latency_disparity_is_gauge_only():
+    """Per-group latency ratios are exported but NEVER alert — queue
+    position confounds them in a batch sweep."""
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        mon.min_group_n = 2
+        for i in range(4):
+            g = "early" if i < 2 else "late"
+            req = Request(prompt="p", id=f"l{i}", group=g, attribute="t")
+            mon.observe_request(req, "completed", queue_wait_s=0.01 if
+                                g == "early" else 10.0, ttft_s=0.02 if
+                                g == "early" else 10.0)
+        ratio = reg.read_value("fairness_disparity", component="fairness",
+                               attribute="t", signal="queue_wait_mean_ratio")
+        assert ratio > 100
+        assert reg.read_value("fairness_alerts_total", component="fairness",
+                              attribute="t",
+                              signal="queue_wait_mean_ratio") == 0
+
+
+def test_duplicate_terminal_keeps_pair_joinable():
+    """A duplicate terminal observation for the FIRST member of a
+    direct-tagged pair must not destroy the half-registered placeholder —
+    the twin still joins the pair."""
+    with use_registry():
+        mon = FairnessMonitor()
+        ra = Request(prompt="same", id="d0", group="x", attribute="t",
+                     pair_id="dp")
+        rb = Request(prompt="same", id="d1", group="y", attribute="t",
+                     pair_id="dp")
+        mon.observe_request(ra, "completed", text="w")
+        mon.observe_request(ra, "completed", text="w")  # duplicate
+        mon.observe_request(rb, "completed", text="w")
+        assert mon.pairs_joined == 1
+        assert mon.pairs_divergent == 0
+
+
+# -- journal persistence of study tags ----------------------------------------
+
+
+def test_journal_persists_study_tags(tmp_path):
+    from fairness_llm_tpu.resilience.drain import ServingJournal
+
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(Request(prompt="p", id="r0", group="g1",
+                               attribute="gender", pair_id="p0"))
+    j.record_submitted(Request(prompt="q", id="r1"))
+    j.close()
+    reqs = {r.id: r for r in ServingJournal(str(tmp_path)).to_requests()}
+    assert reqs["r0"].group == "g1"
+    assert reqs["r0"].attribute == "gender"
+    assert reqs["r0"].pair_id == "p0"
+    assert reqs["r1"].group is None and reqs["r1"].pair_id is None
+
+
+# -- validator + report surface ------------------------------------------------
+
+
+def _study_snapshot_dir(tmp_path, perturb_offline=False):
+    with use_registry() as reg, use_fairness_monitor() as mon:
+        _feed_study(mon)
+        by_group = {"g1": [RECS["a0"], RECS["a1"]],
+                    "g2": [RECS["b0"], RECS["b1"]]}
+        off_dp, _ = demographic_parity(by_group)
+        off_if, _ = individual_fairness(PAIRS, RECS)
+        off_ex, _ = group_exposure(by_group)
+        if perturb_offline:
+            off_dp += 0.05  # a real aggregation bug's signature
+        publish_offline_reference({"gender": off_dp}, if_score=off_if,
+                                  exposure={"gender": off_ex})
+        # The gate also wants tagged serving traffic.
+        mon.observe_request(
+            Request(prompt="p", id="a0", group="g1", attribute="gender"),
+            "completed", queue_wait_s=0.01, ttft_s=0.02,
+        )
+        write_snapshot(reg, str(tmp_path))
+    return str(tmp_path)
+
+
+def test_require_fairness_gate(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "validate_telemetry",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "validate_telemetry.py"),
+    )
+    vt = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vt)
+
+    good = _study_snapshot_dir(tmp_path / "good")
+    assert vt.check(good, require_fairness=True) == 0
+    bad = _study_snapshot_dir(tmp_path / "bad", perturb_offline=True)
+    assert vt.check(bad, require_fairness=True) == 1
+
+
+def test_fairness_report_renders(tmp_path):
+    path = _study_snapshot_dir(tmp_path)
+    with open(os.path.join(path, "telemetry_snapshot.json")) as f:
+        snap = json.load(f)
+    text = render_fairness_report(snap, events=[{
+        "kind": "fairness_pair_divergent", "pair_id": "p9",
+        "attribute": "gender", "cause": "failed", "js_distance": 1.0,
+        "members": {"x": {"outcome": "failed",
+                          "events": ["requeued:device"]}},
+    }])
+    assert "FAIRNESS SIGNALS" in text
+    assert "dp" in text and "gender" in text
+    assert "p9" in text and "requeued:device" in text
+    # Empty snapshot renders a hint, not a traceback.
+    assert "no fairness instruments" in render_fairness_report(
+        {"counters": [], "gauges": []})
+
+
+def test_serving_backend_stamps_tags(engine):
+    """ServingBackend.generate stamps registered study tags onto its sweep
+    requests — verified through the journal ledger the scheduler writes."""
+    from fairness_llm_tpu.serving.backend import ServingBackend
+
+    with use_registry(), use_fairness_monitor() as mon:
+        mon.begin_study()
+        mon.register_request("user_0", {"gender": "m"})
+        mon.register_pair("pr0", "user_0", "user_1", "gender")
+        backend = ServingBackend(engine, SCFG)
+        texts = backend.generate(["the quick brown fox"], GREEDY,
+                                 keys=["user_0"])
+        assert texts[0]
+        # The terminal hook saw the tagged request: audit counters exist.
+        reg_val = mon._reg().read_value(
+            "fairness_requests_total", component="fairness",
+            attribute="gender", group="m", outcome="completed")
+        assert reg_val == 1

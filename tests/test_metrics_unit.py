@@ -41,6 +41,62 @@ def test_js_distance_matches_scipy_with_eps_semantics():
     assert float(js_distance(p_counts, q_counts)) == pytest.approx(float(expected), abs=1e-5)
 
 
+def test_js_distance_properties():
+    """Kernel invariants the streaming fairness layer leans on
+    (telemetry/fairness.py measures pair divergence with this kernel):
+    identity -> 0, symmetry, bounded by sqrt(ln 2), scale invariance."""
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        p = rng.integers(0, 6, size=8).astype(np.float64)
+        q = rng.integers(0, 6, size=8).astype(np.float64)
+        if p.sum() == 0 or q.sum() == 0:
+            continue
+        d_pq = float(js_distance(p, q))
+        d_qp = float(js_distance(q, p))
+        assert d_pq == pytest.approx(d_qp, abs=1e-6)  # symmetric
+        assert -1e-7 <= d_pq <= np.sqrt(np.log(2)) + 1e-6  # bounded
+        # Scale invariance: counts are normalized to distributions.
+        assert float(js_distance(3 * p, q)) == pytest.approx(d_pq, abs=1e-5)
+    identical = np.array([2.0, 0.0, 5.0, 1.0])
+    assert float(js_distance(identical, identical)) == pytest.approx(
+        0.0, abs=1e-6)
+    disjoint_a = np.array([1.0, 1.0, 0.0, 0.0])
+    disjoint_b = np.array([0.0, 0.0, 1.0, 1.0])
+    # Fully disjoint support -> the JS distance maximum sqrt(ln 2)
+    # (natural-log convention, the scipy default the reference uses).
+    assert float(js_distance(disjoint_a, disjoint_b)) == pytest.approx(
+        np.sqrt(np.log(2)), abs=1e-3)
+
+
+def test_kl_divergence_properties():
+    p = np.array([0.25, 0.25, 0.25, 0.25])
+    assert float(kl_divergence(p, p)) == pytest.approx(0.0, abs=1e-7)
+    q = np.array([0.7, 0.1, 0.1, 0.1])
+    # KL is asymmetric and non-negative.
+    kl_pq, kl_qp = float(kl_divergence(p, q)), float(kl_divergence(q, p))
+    assert kl_pq >= 0 and kl_qp >= 0
+    assert kl_pq != pytest.approx(kl_qp, abs=1e-4)
+    assert kl_pq == pytest.approx(float(scipy.stats.entropy(p, q)), abs=1e-5)
+
+
+def test_pairwise_js_matrix_matches_pairwise_calls():
+    from fairness_llm_tpu.metrics.divergence import pairwise_js_matrix
+
+    counts = np.array([
+        [3.0, 1.0, 0.0, 2.0],
+        [0.0, 2.0, 4.0, 0.0],
+        [1.0, 1.0, 1.0, 1.0],
+    ])
+    mat = np.asarray(pairwise_js_matrix(counts))
+    assert mat.shape == (3, 3)
+    for i in range(3):
+        assert mat[i, i] == pytest.approx(0.0, abs=1e-6)
+        for j in range(3):
+            assert mat[i, j] == pytest.approx(mat[j, i], abs=1e-6)
+            assert mat[i, j] == pytest.approx(
+                float(js_distance(counts[i], counts[j])), abs=1e-6)
+
+
 def test_demographic_parity_identical_groups_is_one():
     recs = {"a": [["X", "Y"], ["Z"]], "b": [["X", "Y"], ["Z"]]}
     score, details = demographic_parity(recs)

@@ -35,7 +35,16 @@ What it does, in one process, deterministically:
    accepted-then-lost requests, nonzero ``shed_total`` counters, and the
    controller de-escalating to level 0 after the flood
    (``validate_telemetry --require-overload`` gates it);
-8. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
+8. drills FAIRNESS OBSERVABILITY (ISSUE 9): byte-identical counterfactual
+   pair probes (same prompt, different group tag) through a fault-free
+   scheduler stay SILENT — every pair joins with zero divergence and no
+   neutrality alert — then the same workload with decode faults targeted
+   at ONE group's requests must raise ``fairness_alerts_total`` (group
+   disparity in the impaired-rate audit) and count the divergent pairs
+   with their members' serving events attributed (the requeues the
+   injected faults caused); the rendered fairness report is written
+   beside the snapshot (``fairness_report.txt``) for failure evidence;
+9. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
    lost), survivors token-for-token equal to the baseline (zero corrupt
    records — the NaN chunk was retried, not delivered), the breaker cycle
    + hang + numerics fault + manifest failure + canary mismatch + fleet
@@ -410,6 +419,94 @@ def main() -> int:
     check(ctl.level == 0 and reg.read_value(
               "overload_level", component="serving") == 0,
           "shed controller de-escalated to level 0 after the flood")
+
+    # 8. Fairness observability (ISSUE 9): the serving-neutrality audit and
+    # counterfactual pair watch. Pair probes are byte-identical prompts
+    # tagged with different groups — the serving-layer counterfactual: any
+    # output or delivery difference between members is serving treatment,
+    # not model bias. Fault-free first (must be silent), then faults
+    # targeted at ONE group (must alert, with the pairs attributed).
+    from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
+
+    fair_prompts = [PROMPTS["ok0"], PROMPTS["flaky"], PROMPTS["pfault"],
+                    PROMPTS["hangme"]]
+
+    def fairness_requests(tag):
+        reqs = []
+        for i, p in enumerate(fair_prompts):
+            for g in ("g_ctrl", "g_tgt"):
+                reqs.append(Request(
+                    prompt=p, id=f"fair_{tag}_{g}_{i}", settings=GREEDY,
+                    group=g, attribute="drill", pair_id=f"fair_{tag}_p{i}",
+                ))
+        return reqs
+
+    mon = get_fairness_monitor()
+    mon.begin_study()
+    fair_sched = ContinuousScheduler(engine, SERVING, settings=GREEDY)
+    ctrl = {r.id: r for r in fair_sched.serve(fairness_requests("ctrl"))}
+    reg = T.get_registry()
+    alerts_before = reg.read_value(
+        "fairness_alerts_total", component="fairness", attribute="drill",
+        signal="impaired_rate",
+    )
+    check(all(r.ok for r in ctrl.values())
+          and mon.pairs_joined == len(fair_prompts)
+          and mon.pairs_divergent == 0 and alerts_before == 0,
+          f"fault-free neutrality control silent ({mon.pairs_joined} pairs "
+          "joined, zero divergence, no alert)")
+
+    # Fresh study for the biased half: sharing the control run's stats
+    # would dilute the end-state disparity to exactly the alert threshold
+    # (2 impaired over 8 = 0.25), making the alert depend on terminal
+    # ordering; reset makes it 2/4 = 0.5, deterministic.
+    mon.begin_study()
+    biased_inj = ScriptedFaultInjector(
+        faults={(f"fair_biased_g_tgt_{i}", "decode"): 2 for i in (0, 1)},
+    )
+    biased_sched = ContinuousScheduler(engine, SERVING, settings=GREEDY,
+                                       fault_injector=biased_inj)
+    biased = {r.id: r for r in biased_sched.serve(
+        fairness_requests("biased"))}
+    alerts_after = reg.read_value(
+        "fairness_alerts_total", component="fairness", attribute="drill",
+        signal="impaired_rate",
+    )
+    targeted_failed = [rid for rid, r in biased.items()
+                       if "g_tgt" in rid and not r.ok]
+    check(len(targeted_failed) == 2,
+          "group-targeted faults failed exactly the targeted requests "
+          f"({targeted_failed})")
+    check(alerts_after >= 1,
+          f"neutrality audit raised fairness_alerts_total "
+          f"({alerts_after:g}) on group-targeted faults")
+    divergent = [d for d in mon.divergent
+                 if d["pair_id"].startswith("fair_biased")]
+    attributed = [
+        d for d in divergent
+        if any("requeued" in e for m in d["members"].values()
+               for e in (m.get("events") or []))
+    ]
+    check(len(divergent) >= 2 and len(attributed) >= 2,
+          f"{len(divergent)} divergent pair(s) attributed to the injected "
+          f"faults' requeues ({len(attributed)} with requeue events)")
+    disparity = reg.read_value("fairness_disparity", component="fairness",
+                               attribute="drill", signal="impaired_rate")
+    check(disparity >= 0.25,
+          f"impaired-rate disparity gauge reflects the bias "
+          f"({disparity:g})")
+    if a.telemetry_dir:
+        # The rendered fairness report rides the telemetry artifact — the
+        # failure-evidence upload includes the attribution table.
+        from fairness_llm_tpu.telemetry import render_fairness_report
+
+        with open(os.path.join(a.telemetry_dir, "fairness_report.txt"),
+                  "w", encoding="utf-8") as f:
+            f.write(render_fairness_report(
+                T.snapshot(T.get_registry()),
+                events=[{"kind": "fairness_pair_divergent", **d}
+                        for d in mon.divergent],
+            ) + "\n")
 
     snap = T.snapshot(T.get_registry())
     # Unlabeled entries only: the fleet section's per-replica boards write

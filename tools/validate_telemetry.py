@@ -34,6 +34,14 @@ containing prefill + decode spans and request lanes.
 drill produces (ISSUE 8): nonzero ``shed_total``, an
 ``overload_transitions_total`` escalation AND a return to level 0, and
 every ``overload_level`` gauge ending at 0.
+``--require-fairness`` requires the fairness-observability signals a
+fault-free ``--fairness-obs --continuous`` study produces (ISSUE 9):
+nonzero ``fairness_requests_total`` and ``fairness_pairs_joined_total``,
+populated ``fairness_dp``/``fairness_if``/``fairness_exposure_ratio``
+run-window gauges in [0, 1], each streaming gauge matching its
+``fairness_offline_*`` counterpart to fp tolerance (the live-vs-offline
+cross-check), ZERO ``fairness_pair_divergence_total``, and ZERO
+``fairness_alerts_total`` — a fault-free run must be silent.
 """
 
 from __future__ import annotations
@@ -54,11 +62,14 @@ def check(path: str, require_serving: bool = False,
           require_integrity: bool = False,
           require_fleet: bool = False,
           require_profile: bool = False,
-          require_overload: bool = False) -> int:
+          require_overload: bool = False,
+          require_fairness: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
     if require_profile:
         problems.extend(_check_profile(path, snap))
+    if require_fairness:
+        problems.extend(_check_fairness(snap))
     if require_overload:
         counters = snap.get("counters", [])
 
@@ -204,6 +215,86 @@ def check(path: str, require_serving: bool = False,
     return 0
 
 
+# |live - offline| bound for the streaming-vs-offline fairness cross-check:
+# identical kernels over identically-valued count matrices, differing only
+# in float summation order (vocab interning order) and float32-vs-float64
+# mean accumulation — observed deltas are ~1e-7; 1e-4 leaves margin without
+# letting a real aggregation bug (wrong group, dropped list) through.
+FAIRNESS_TOLERANCE = 1e-4
+
+
+def _check_fairness(snap: dict) -> list:
+    """The --require-fairness gate (ISSUE 9): streaming group metrics
+    populated and matching the offline scores, pair watch joined, and a
+    fault-free run SILENT (zero divergence, zero neutrality alerts)."""
+    problems = []
+    counters = snap.get("counters", [])
+    gauges = snap.get("gauges", [])
+
+    def total(name):
+        return sum(c["value"] for c in counters if c.get("name") == name)
+
+    def gauge_rows(name, **want):
+        out = []
+        for g in gauges:
+            lb = g.get("labels", {})
+            if g.get("name") == name and all(lb.get(k) == v
+                                             for k, v in want.items()):
+                out.append(g)
+        return out
+
+    if not total("fairness_requests_total"):
+        problems.append("fairness_requests_total is zero (no tagged "
+                        "request ever finished — was --fairness-obs on?)")
+    if not total("fairness_pairs_joined_total"):
+        problems.append("fairness_pairs_joined_total is zero (the pair "
+                        "watch never joined a counterfactual pair)")
+    for name in ("fairness_dp", "fairness_if", "fairness_exposure_ratio"):
+        rows = gauge_rows(name, window="run")
+        if not rows:
+            problems.append(f"no run-window {name} gauge (streaming "
+                            "accumulators never refreshed)")
+        for g in rows:
+            if not 0.0 <= g["value"] <= 1.0:
+                problems.append(f"{name} {g.get('labels', {})} = "
+                                f"{g['value']:g} outside [0, 1]")
+    # Live-vs-offline cross-check: every published offline reference must
+    # have a streaming counterpart within tolerance.
+    offline_of = {"fairness_offline_dp": "fairness_dp",
+                  "fairness_offline_if": "fairness_if",
+                  "fairness_offline_exposure": "fairness_exposure_ratio"}
+    checked = 0
+    for off_name, live_name in offline_of.items():
+        for off in gauge_rows(off_name):
+            attr = off.get("labels", {}).get("attribute")
+            live = gauge_rows(live_name, attribute=attr, window="run")
+            if not live:
+                problems.append(f"{off_name}{{attribute={attr}}} has no "
+                                f"run-window {live_name} counterpart")
+                continue
+            checked += 1
+            delta = abs(live[0]["value"] - off["value"])
+            if delta > FAIRNESS_TOLERANCE:
+                problems.append(
+                    f"{live_name}{{attribute={attr}}} = "
+                    f"{live[0]['value']:.6f} vs offline {off['value']:.6f} "
+                    f"(|delta| {delta:.2e} > {FAIRNESS_TOLERANCE:g}) — "
+                    "streaming accumulation diverged from the batch metric"
+                )
+    if not checked:
+        problems.append("no fairness_offline_* gauges (the phase never "
+                        "published its offline reference scores)")
+    # A fault-free run must be SILENT: serving treated every group equally
+    # and no pair's delivery was impaired.
+    if total("fairness_pair_divergence_total"):
+        problems.append("fairness_pair_divergence_total is nonzero in a "
+                        "fault-free run (serving impaired a pair member)")
+    if total("fairness_alerts_total"):
+        problems.append("fairness_alerts_total is nonzero in a fault-free "
+                        "run (the neutrality audit saw group disparity)")
+    return problems
+
+
 def _check_profile(path: str, snap: dict) -> list:
     """The --require-profile gate: compile events, roofline gauges, step
     gaps, and a schema-valid trace.json with the span kinds the ISSUE-7
@@ -256,13 +347,15 @@ def main() -> int:
     ap.add_argument("--require-fleet", action="store_true")
     ap.add_argument("--require-profile", action="store_true")
     ap.add_argument("--require-overload", action="store_true")
+    ap.add_argument("--require-fairness", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
                  require_integrity=a.require_integrity,
                  require_fleet=a.require_fleet,
                  require_profile=a.require_profile,
-                 require_overload=a.require_overload)
+                 require_overload=a.require_overload,
+                 require_fairness=a.require_fairness)
 
 
 if __name__ == "__main__":
