@@ -1057,6 +1057,83 @@ def phase2_7b_committed() -> dict | None:
         return None
 
 
+def measure_prefix_cache(engine, prompts, settings_cls) -> dict | None:
+    """Paged KV + radix prefix reuse A/B on the phase-1-shaped sweep
+    (ISSUE 10 / ROADMAP item 1).
+
+    The counterfactual prompts are byte-identical except for the trailing
+    demographics block, so with ``--paged-kv`` admission should match most
+    of every prompt and prefill only the short suffix. Same engine/params,
+    same slots, greedy for parity; best-of-3 per mode in one process
+    (docs/PERFORMANCE.md methodology — the CPU harness has ±30-60%
+    single-run jitter). Reported: profiles/sec off vs on, prefill tokens
+    off vs on (the measured reduction), and the radix hit rate — with
+    token parity asserted and the ROADMAP >80% hit-rate target asserted
+    on the warm-cache timed runs.
+    """
+    import dataclasses
+
+    from fairness_llm_tpu.config import ServingConfig, default_config
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+
+    num_slots = max(default_config().decode_batch_size, 1)
+    budget = 32  # modest decode: keep prefill visible in the wall
+
+    def greedy(m):
+        return _greedy(settings_cls, m)
+
+    scfg = ServingConfig(
+        enabled=True, num_slots=num_slots, max_prompt_len=512,
+        max_new_tokens=budget, decode_chunk=8,
+    )
+    pcfg = dataclasses.replace(scfg, paged_kv=True, kv_block_size=32)
+
+    def run(sched, tag, rep):
+        reqs = [
+            Request(prompt=p, id=f"px_{tag}_{rep}_{i:04d}",
+                    settings=greedy(budget))
+            for i, p in enumerate(prompts)
+        ]
+        t0 = time.perf_counter()
+        results = sched.serve(reqs)
+        wall = time.perf_counter() - t0
+        assert all(r.ok for r in results)
+        toks = [tuple(int(t) for t in r.tokens) for r in results]
+        return wall, toks, sched.last_stats
+
+    out = {"profiles": len(prompts), "num_slots": num_slots,
+           "kv_block_size": 32}
+    tokens = {}
+    for tag, cfg in (("off", scfg), ("on", pcfg)):
+        sched = ContinuousScheduler(engine, cfg, settings=greedy(budget))
+        run(sched, tag, 0)  # warmup: compiles AND (on) populates the radix
+        wall, toks, stats = min((run(sched, tag, rep) for rep in (1, 2, 3)),
+                                key=lambda r: r[0])
+        tokens[tag] = toks
+        out[tag] = {
+            "wall_s": round(wall, 3),
+            "profiles_per_sec": round(len(prompts) / wall, 2),
+            "prefill_tokens": stats.prefill_tokens,
+        }
+        if tag == "on":
+            paged = sched.pool.paged
+            out[tag]["hit_ratio"] = round(paged.hit_ratio, 4)
+            # The ROADMAP item-1 target, on the workload just decoded.
+            assert paged.hit_ratio > 0.8, (
+                f"warm-cache hit ratio {paged.hit_ratio:.3f} <= 0.8"
+            )
+    # Prefix reuse must never change the tokens — the parity contract.
+    assert tokens["on"] == tokens["off"], "paged KV changed output"
+    out["prefill_token_reduction"] = round(
+        1.0 - out["on"]["prefill_tokens"] / max(out["off"]["prefill_tokens"],
+                                                1), 4
+    )
+    out["speedup_ratio"] = round(
+        out["off"]["wall_s"] / out["on"]["wall_s"], 3
+    )
+    return out
+
+
 def build_sweep_prompts():
     from fairness_llm_tpu.config import default_config
     from fairness_llm_tpu.data import (
@@ -1406,6 +1483,16 @@ def _run() -> None:
         print(f"fairness overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Paged-KV prefix-cache A/B (ISSUE 10): the phase-1-shaped sweep with
+    # private-row slots vs the paged radix-indexed arena — profiles/sec,
+    # measured prefill-token reduction, and the hit rate, parity asserted.
+    prefix_cache = None
+    try:
+        prefix_cache = measure_prefix_cache(engine, prompts, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"prefix cache A/B skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -1742,6 +1829,7 @@ def _run() -> None:
             "fleet": fleet,
             "overload_overhead": overload,
             "fairness_overhead": fairness,
+            "prefix_cache": prefix_cache,
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
             "large_sweep_int8w_int8kv": large_sweep_int8w,

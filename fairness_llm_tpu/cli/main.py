@@ -133,6 +133,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="with --continuous: concurrent KV slots "
                         "(= decode-step batch rows)")
+    p.add_argument("--paged-kv", action="store_true",
+                   help="with --continuous: paged KV cache with radix-tree "
+                        "prefix reuse (serving/paged.py) — slots hold block "
+                        "tables into one shared arena, admission matches "
+                        "the longest cached prompt prefix (refcounted, "
+                        "copy-on-write at the divergence point) and "
+                        "prefills only the unmatched suffix. The "
+                        "counterfactual sweep's near-duplicate prompts "
+                        "become lookups; greedy output is token-for-token "
+                        "identical to the non-paged path")
+    p.add_argument("--kv-block-size", type=int, default=None, metavar="B",
+                   help="with --paged-kv: tokens per KV block — the "
+                        "prefix-sharing granularity (default 16)")
+    p.add_argument("--kv-blocks", type=int, default=None, metavar="N",
+                   help="with --paged-kv: total arena blocks (default 2x "
+                        "the all-slots-private worst case, so a full pool "
+                        "still leaves an equal prefix-cache reserve)")
     p.add_argument("--overload", action="store_true",
                    help="with --continuous: arm overload control "
                         "(serving/overload.py) — QoS classes (interactive/"
@@ -346,16 +363,30 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--ngram-max must be >= 1")
             spec_kwargs["ngram_max"] = args.ngram_max
         updates["speculation"] = SpeculationConfig(**spec_kwargs)
-    if args.continuous or args.slots is not None:
+    if args.continuous or args.slots is not None or args.paged_kv \
+            or args.kv_block_size is not None or args.kv_blocks is not None:
         from fairness_llm_tpu.config import ServingConfig
 
+        if not args.paged_kv and (args.kv_block_size is not None
+                                  or args.kv_blocks is not None):
+            raise SystemExit("--kv-block-size/--kv-blocks require --paged-kv")
         if not args.continuous:
-            raise SystemExit("--slots requires --continuous")
+            raise SystemExit("--slots/--paged-kv require --continuous")
         serve_kwargs = {"enabled": True}
         if args.slots is not None:
             if args.slots < 1:
                 raise SystemExit("--slots must be >= 1")
             serve_kwargs["num_slots"] = args.slots
+        if args.paged_kv:
+            serve_kwargs["paged_kv"] = True
+            if args.kv_block_size is not None:
+                if args.kv_block_size < 1:
+                    raise SystemExit("--kv-block-size must be >= 1")
+                serve_kwargs["kv_block_size"] = args.kv_block_size
+            if args.kv_blocks is not None:
+                if args.kv_blocks < 1:
+                    raise SystemExit("--kv-blocks must be >= 1")
+                serve_kwargs["kv_blocks"] = args.kv_blocks
         updates["serving"] = ServingConfig(**serve_kwargs)
     overload_flags = (args.shed_burn_threshold, args.shed_healthy_window,
                       args.batch_token_cap)

@@ -39,7 +39,10 @@ from fairness_llm_tpu.data.profiles import Profile, profile_pairs
 from fairness_llm_tpu.pipeline import results as R
 from fairness_llm_tpu.pipeline.backends import DecodeBackend, backend_for
 from fairness_llm_tpu.pipeline.parsing import canonicalize, parse_numbered_list
-from fairness_llm_tpu.pipeline.prompts import recommendation_prompt
+from fairness_llm_tpu.pipeline.prompts import (
+    check_late_divergence,
+    recommendation_prompt,
+)
 from fairness_llm_tpu.telemetry import (
     Heartbeat,
     get_fairness_monitor,
@@ -248,6 +251,16 @@ def run_phase1(
     # --- the sweep: demographic prompts + one neutral prompt set for SNSR/SNSV
     prompts = [recommendation_prompt(p) for p in profiles]
     keys = [p.id for p in profiles]
+    # Prefix-reuse layout check (pipeline/prompts.py): counterfactual pairs
+    # must diverge LATE (demographics last) or the paged KV cache has
+    # nothing to share. Measured every run, warned when violated, recorded
+    # in metadata below; tools/prefix_stats.py inspects it pre-run.
+    prompt_by_key = dict(zip(keys, prompts))
+    divergence = check_late_divergence(
+        [(prompt_by_key[a], prompt_by_key[b])
+         for a, b in profile_pairs(profiles)],
+        phase="phase1",
+    )
     neutral_keys = []
     per_combo = num_profiles or config.profiles_per_combo
     for i in range(per_combo):
@@ -397,6 +410,10 @@ def run_phase1(
             # cross-check this study artifact carries (None when
             # --fairness-obs was off)
             "fairness": fairness_block,
+            # counterfactual-pair shared-prefix fractions (byte LCP / max
+            # len) — the layout property the paged KV cache's hit rate
+            # rides on; see pipeline/prompts.py check_late_divergence
+            "prompt_divergence": divergence,
         },
         "profiles": [p.to_dict() for p in profiles],
         "recommendations": {

@@ -111,6 +111,18 @@ def apply_facter(
         )
         for p in profiles
     ]
+    # Same prefix-reuse layout check as phase 1 (pipeline/prompts.py): the
+    # mitigation sweep's counterfactual pairs must also diverge late —
+    # anonymized variants share EVERYTHING, demographic ones everything up
+    # to the trailing demographics block.
+    from fairness_llm_tpu.data.profiles import profile_pairs
+    from fairness_llm_tpu.pipeline.prompts import check_late_divergence
+
+    prompt_of = dict(zip((p.id for p in profiles), prompts))
+    check_late_divergence(
+        [(prompt_of[a], prompt_of[b]) for a, b in profile_pairs(profiles)],
+        phase="phase3",
+    )
     if variant == "aggressive" and settings is not None:
         # Maximal-pressure decode: near-greedy sampling (reference uses
         # temperature 0.1 for this variant vs 0.2 for smart).

@@ -17,10 +17,13 @@ has a single text stream, so the system directive is folded into the prompt.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from fairness_llm_tpu.data.profiles import Profile
 from fairness_llm_tpu.data.ranking import RankingItem
+
+logger = logging.getLogger(__name__)
 
 RECOMMENDER_SYSTEM = (
     "You are a helpful movie recommendation system. "
@@ -67,6 +70,67 @@ def recommendation_prompt(
         f"{demo}\n"
         f"Recommendations:"
     )
+
+
+def lcp_len(a: str, b: str) -> int:
+    """Length of the longest common prefix of two strings."""
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+def divergence_stats(
+    pair_prompts: Sequence[Tuple[str, str]]
+) -> Dict[str, float]:
+    """How LATE counterfactual pairs diverge — the property the paged KV
+    cache's hit rate rides on (the shared-everything-but-demographics
+    layout ``recommendation_prompt`` documents).
+
+    For each (prompt_a, prompt_b) pair: ``lcp / max(len)`` — the fraction
+    of the longer prompt that is byte-shared. Returns min/mean/max over
+    the pairs (empty input -> all zeros)."""
+    fracs: List[float] = []
+    for a, b in pair_prompts:
+        denom = max(len(a), len(b), 1)
+        fracs.append(lcp_len(a, b) / denom)
+    if not fracs:
+        return {"pairs": 0, "min_frac": 0.0, "mean_frac": 0.0,
+                "max_frac": 0.0}
+    return {
+        "pairs": len(fracs),
+        "min_frac": min(fracs),
+        "mean_frac": sum(fracs) / len(fracs),
+        "max_frac": max(fracs),
+    }
+
+
+# The layout contract the paged KV cache depends on: a counterfactual pair
+# must share at least this fraction of its bytes as a prefix. The stock
+# template puts the demographics block last and clears ~0.9; a custom
+# template that leads with demographics would tank the prefix-cache hit
+# rate — warn loudly instead of silently serving at full prefill cost.
+LATE_DIVERGENCE_MIN_FRAC = 0.5
+
+
+def check_late_divergence(
+    pair_prompts: Sequence[Tuple[str, str]], phase: str = "phase1"
+) -> Dict[str, float]:
+    """Measure pair divergence and WARN when the swap lands early. The
+    stats land in the phase's result metadata either way, so the expected
+    prefix-cache hit rate is inspectable before (tools/prefix_stats.py)
+    and after a run."""
+    stats = divergence_stats(pair_prompts)
+    if stats["pairs"] and stats["min_frac"] < LATE_DIVERGENCE_MIN_FRAC:
+        logger.warning(
+            "%s: counterfactual pairs diverge EARLY (min shared-prefix "
+            "fraction %.2f < %.2f) — the demographic swap should land as "
+            "late as possible in the prompt or prefix-KV reuse "
+            "(--paged-kv) has little to share",
+            phase, stats["min_frac"], LATE_DIVERGENCE_MIN_FRAC,
+        )
+    return stats
 
 
 FAIRNESS_INSTRUCTIONS: Dict[str, str] = {

@@ -3,7 +3,9 @@
 Layers (bottom-up): ``request`` (Request/Result wire format, QoS classes)
 -> ``queue`` (bounded admission + rate limiting; per-class sub-queues in
 QoS mode) -> ``overload`` (shed controller + deadline-feasibility
-admission) -> ``slots`` (KV slot pool allocator)
+admission) -> ``paged`` (block arena + radix-tree prefix index, the
+``--paged-kv`` shared-prefix layout) -> ``slots`` (KV slot pool allocator,
+block-table owner in paged mode)
 -> ``scheduler`` (the prefill/decode step loop) -> ``router``/``fleet``
 (health-aware routing over N replica schedulers, per-replica fault domains
 with fence/migrate/rejoin) -> ``backend`` (the ``DecodeBackend`` adapter
@@ -16,6 +18,12 @@ from fairness_llm_tpu.serving.overload import (
     DeadlineEstimator,
     ShedController,
 )
+from fairness_llm_tpu.serving.paged import (
+    BlockArena,
+    PagedKV,
+    RadixIndex,
+    init_arena,
+)
 from fairness_llm_tpu.serving.queue import AdmissionQueue, ClassedAdmissionQueue
 from fairness_llm_tpu.serving.request import QOS_CLASSES, Request, Result
 from fairness_llm_tpu.serving.router import HealthRouter
@@ -24,9 +32,13 @@ from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 
 __all__ = [
     "AdmissionQueue",
+    "BlockArena",
     "ClassedAdmissionQueue",
     "ContinuousScheduler",
     "DeadlineEstimator",
+    "PagedKV",
+    "RadixIndex",
+    "init_arena",
     "QOS_CLASSES",
     "ShedController",
     "HealthRouter",

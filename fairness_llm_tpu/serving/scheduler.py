@@ -104,6 +104,12 @@ from fairness_llm_tpu.serving.request import (
     Request,
     Result,
 )
+from fairness_llm_tpu.serving.paged import (
+    PagedKV,
+    gather_view,
+    init_arena,
+    scatter_view,
+)
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 from fairness_llm_tpu.telemetry import (
     Heartbeat,
@@ -205,9 +211,28 @@ class ContinuousScheduler:
         # budget above is what bounds positions, so a bucket overshooting the
         # budget just leaves a few always-invalid slots per row).
         self.max_prompt_bucket = _bucket_len(self.prompt_budget, engine.seq_bucket)
-        self.cache_len = self.max_prompt_bucket + cap
         self.num_slots = self.serving.num_slots
-        self.pool = SlotPool(self.num_slots)
+        # Paged KV (serving/paged.py, --paged-kv): slots map into a shared
+        # block arena through per-slot tables, and admission reuses cached
+        # prompt prefixes via the radix index. The per-slot logical extent
+        # must cover the real prompt budget + the decode cap, PLUS one
+        # suffix bucket of headroom: suffix prefill writes a bucketed
+        # [S]-token window at the row's matched offset, and same-bucket
+        # grouping bounds that window's end by prompt_budget + seq_bucket.
+        self.paged = bool(self.serving.paged_kv)
+        if self.paged:
+            bs = self.serving.kv_block_size
+            span = self.prompt_budget + max(cap, engine.seq_bucket)
+            blocks_per_slot = -(-span // bs)
+            self.cache_len = blocks_per_slot * bs  # the gathered view length
+            self.pool = SlotPool(self.num_slots, paged=PagedKV(
+                self.num_slots, blocks_per_slot, bs,
+                num_blocks=self.serving.kv_blocks,
+                labels={"replica": replica} if replica else None,
+            ))
+        else:
+            self.cache_len = self.max_prompt_bucket + cap
+            self.pool = SlotPool(self.num_slots)
         # Overload control (serving/overload.py): with it armed, the queue
         # becomes the per-class variant and the shed controller +
         # deadline-feasibility estimator gate admission at this front door.
@@ -241,9 +266,18 @@ class ContinuousScheduler:
         # Sheds recorded outside a drain (public submit() refusals between
         # drains) — folded into the next drain's stats like rejections.
         self._shed_untaken = 0
-        # Persistent device state: the shared KV cache + each slot's carried
-        # next-token logits (f32 — what the sampler consumes).
-        self._cache = init_cache(cfg, self.num_slots, self.cache_len)
+        # Persistent device state: the shared KV cache (private rows, or the
+        # paged block arena) + each slot's carried next-token logits (f32 —
+        # what the sampler consumes).
+        if self.paged:
+            self._cache = None
+            self._arena = init_arena(
+                cfg, self.pool.paged.num_blocks,
+                self.serving.kv_block_size, self.num_slots,
+            )
+        else:
+            self._cache = init_cache(cfg, self.num_slots, self.cache_len)
+            self._arena = None
         self._prev_logits = jnp.zeros(
             (self.num_slots, cfg.vocab_size), jnp.float32
         )
@@ -479,6 +513,169 @@ class ContinuousScheduler:
             _, cache, prev_logits, _, emitted, toks, counters = \
                 jax.lax.while_loop(cond, body, init)
             return cache, prev_logits, toks, emitted, counters
+
+        fn = jax.jit(run, donate_argnums=self._donate())
+        self._compiled[key] = fn
+        return fn
+
+    def _paged_prefill_fn(self, nb: int, S: int, guard: bool):
+        """[nb, S] SUFFIX prefill through block tables (--paged-kv).
+
+        Each row's cached prefix (``matched`` tokens: full shared blocks +
+        the copy-on-write lead of one partially-shared block) is already in
+        the arena; this program:
+
+        1. copies the CoW source block into the row's private divergence
+           block (the shared source is never mutated),
+        2. clears ``key_valid`` for EVERY private block in the batch's
+           write tables — the block-granularity invalidation discipline: a
+           recycled block is unreadable before its new tenant's writes,
+        3. gathers each row's table into a contiguous view whose validity
+           is constructed as ``position < matched`` (prefix visible,
+           everything else dark),
+        4. forwards the right-padded suffix with per-row
+           ``write_offsets = matched`` — the speculative-verify causal
+           window: suffix query i sees cached slot j iff j <= matched + i,
+           which is exactly "the whole prefix plus my own earlier suffix",
+        5. scatters the view back through the write tables (shared entries
+           drop) and lands each row's LAST-REAL-TOKEN logits in the carried
+           sampler state.
+
+        Numerically this is the engine's forward over the same token
+        content at the same positions — parity with the non-paged path is
+        pinned in tests/test_paged_kv.py.
+        """
+        key = ("paged_prefill", nb, S, guard)
+        fn = self._compiled.get(key)
+        note_lookup("paged_prefill", hit=fn is not None, labels=self.labels)
+        if fn is not None:
+            return fn
+        model = self.engine.model
+        num_slots = self.num_slots
+
+        def run(params, arena, prev_logits, tokens, valid, positions,
+                tables, wtables, cow_src, cow_dst, matched, slots, last_idx):
+            def cp(big):
+                # Out-of-range cow_dst drops (no-CoW rows); out-of-range
+                # cow_src clamps on the gather, harmless under the drop.
+                return big.at[cow_dst].set(big[cow_src], mode="drop")
+
+            new_layers = []
+            for lc in arena.layers:
+                kw = dict(k=cp(lc.k), v=cp(lc.v))
+                if lc.k_scale is not None:
+                    kw.update(k_scale=cp(lc.k_scale), v_scale=cp(lc.v_scale))
+                new_layers.append(LayerCache(**kw))
+            arena = arena.replace(
+                layers=tuple(new_layers),
+                key_positions=cp(arena.key_positions),
+                key_valid=arena.key_valid.at[wtables].set(False, mode="drop"),
+            )
+            view = gather_view(arena, tables, matched)
+            L = view.key_valid.shape[1]
+            view = view.replace(
+                key_valid=jnp.arange(L)[None, :] < matched[:, None]
+            )
+            logits, view = model.apply(
+                {"params": params}, tokens, positions, valid, view,
+                write_offsets=matched,
+            )
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1
+            )[:, 0, :]
+            arena = scatter_view(arena, view, wtables)
+            arena = arena.replace(
+                lengths=arena.lengths.at[slots].set(view.lengths, mode="drop")
+            )
+            new_logits = prev_logits.at[slots].set(last, mode="drop")
+            if guard:
+                return arena, new_logits, masked_finite(
+                    last, slots < num_slots
+                )
+            return arena, new_logits
+
+        # Not donated, like the plain prefill: a raised call must leave the
+        # other live slots' arena blocks intact.
+        fn = jax.jit(run)
+        self._compiled[key] = fn
+        return fn
+
+    def _paged_step_fn(self):
+        """The paged decode program: gather block tables into the per-row
+        contiguous view ONCE, run the exact same ``decode_chunk`` while_loop
+        the private-row program runs (same sampler streams, same per-row
+        write offsets and stop conditions), scatter the private blocks back
+        once at chunk exit. Shared prefix blocks are read-only by
+        construction (their write-table entries drop), so two rows sharing
+        a prefix stream one copy of its KV bytes from the arena per gather.
+        No reset mask rides this program — released blocks re-enter tables
+        only through a prefill that cleared their ``key_valid`` first."""
+        guard = self._guard()
+        key = ("paged_step", self.decode_chunk, guard)
+        fn = self._compiled.get(key)
+        note_lookup("paged_step", hit=fn is not None, labels=self.labels)
+        if fn is not None:
+            return fn
+        cfg = self.engine.config
+        model = self.engine.model
+        sample = make_sampler(self.sampler)
+        pad_id = self.engine.tokenizer.pad_id
+        eos_id = self.engine.tokenizer.eos_id
+        B, T = self.num_slots, self.decode_chunk
+
+        def run(params, arena, prev_logits, tables, wtables, row_seeds,
+                emitted0, base, caps, live0):
+            cache = gather_view(arena, tables, arena.lengths)
+            row_keys = jax.vmap(jax.random.key)(row_seeds)
+            toks0 = jnp.full((B, T), pad_id, jnp.int32)
+            done0 = ~live0
+            counters0 = jnp.zeros((2,), jnp.int32)
+
+            def cond(carry):
+                t, done = carry[0], carry[3]
+                return (t < T) & ~jnp.all(done)
+
+            def body(carry):
+                t, cache, prev_logits, done, emitted, toks, counters = \
+                    carry[:7]
+                live = ~done
+                step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
+                tok = sample(prev_logits, step_keys)
+                tok = jnp.where(live, tok, pad_id)
+                toks = jax.lax.dynamic_update_slice(
+                    toks, tok[:, None], (jnp.zeros((), jnp.int32), t)
+                )
+                offs = base + emitted
+                pos = cache.lengths[:, None]
+                logits, cache = model.apply(
+                    {"params": params}, tok[:, None], pos, live[:, None],
+                    cache, write_offsets=offs,
+                )
+                prev_logits = jnp.where(
+                    live[:, None], logits[:, -1, :], prev_logits
+                )
+                emitted = emitted + live.astype(jnp.int32)
+                done = done | (tok == eos_id) | (emitted >= caps)
+                counters = counters + jnp.stack(
+                    [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
+                )
+                out = (t + 1, cache, prev_logits, done, emitted, toks,
+                       counters)
+                if guard:
+                    out += (carry[7] & masked_finite(logits[:, -1, :], live),)
+                return out
+
+            init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
+                    emitted0, toks0, counters0)
+            if guard:
+                init += (masked_finite(prev_logits, live0),)
+            c = jax.lax.while_loop(cond, body, init)
+            cache = c[1]
+            arena = scatter_view(arena, cache, wtables)
+            arena = arena.replace(lengths=cache.lengths)
+            if guard:
+                return arena, c[2], c[5], c[4], c[6], c[7]
+            return arena, c[2], c[5], c[4], c[6]
 
         fn = jax.jit(run, donate_argnums=self._donate())
         self._compiled[key] = fn
@@ -1112,6 +1309,8 @@ class ContinuousScheduler:
             admitted.append((req, ids, P))
         if not admitted:
             return False
+        if self.paged:
+            return self._admit_paged(admitted, stats, injected_hang)
 
         # ONE prefill per admission batch, at the max prompt bucket of the
         # batch. Shorter rows pad up to it — numerically free (pad slots are
@@ -1216,6 +1415,186 @@ class ContinuousScheduler:
         stats.admitted += len(admitted)
         return True
 
+    def _admit_paged(self, admitted, stats: ServingStats,
+                     injected_hang: float) -> bool:
+        """Paged admission (--paged-kv): radix-match each popped row, claim
+        blocks (private tail + refs on the shared prefix chain), and prefill
+        ONLY the unmatched suffixes — grouped by suffix bucket so one
+        compiled shape serves each group and every row's bucketed write
+        window provably fits its slot extent.
+
+        Two deferral rules put rows back at the queue head (order
+        preserved) instead of admitting them this iteration:
+
+        - intra-batch sharing: a row whose prompt shares a full block with
+          a row planned THIS iteration waits one iteration, so it matches
+          the committed blocks instead of re-prefilling them — that is how
+          a counterfactual pair arriving together still shares its prefix;
+        - block exhaustion: when the arena (after LRU eviction of
+          unreferenced cache) cannot cover a row's private tail, the row
+          and everything behind it wait for decode to free blocks — the
+          same backpressure shape as a full slot pool.
+        """
+        paged = self.pool.paged
+        bs = paged.block_size
+        planned = []  # (req, ids, slot, plan, real_s)
+        deferred: List[Request] = []
+        pending_chunks: set = set()
+        exhausted = False
+        for req, ids, _ in admitted:
+            if exhausted:
+                deferred.append(req)
+                continue
+            chunks = {tuple(ids[k * bs:(k + 1) * bs])
+                      for k in range(len(ids) // bs)}
+            if chunks & pending_chunks:
+                deferred.append(req)
+                continue
+            slot = self.pool.alloc(SlotState(
+                request=req, base=len(ids), real_len=len(ids),
+            ))
+            assert slot is not None  # admission is free-count bounded
+            plan = paged.admit(slot, ids)
+            if plan is None:
+                self.pool.release(slot)
+                deferred.append(req)
+                exhausted = True
+                continue
+            pending_chunks |= chunks
+            planned.append((req, ids, slot, plan, len(ids) - plan.matched))
+            self.tracer.record(req.id, "admitted")
+        for req in reversed(deferred):
+            self.queue.requeue(req)
+        if not planned:
+            return False
+        groups: Dict[int, list] = {}
+        for row in planned:
+            S = self._bucket_len(row[4], self.engine.seq_bucket)
+            assert row[3].matched + S <= self.cache_len, (
+                "suffix write window overflows the slot extent "
+                f"(matched {row[3].matched} + bucket {S} > {self.cache_len})"
+            )
+            groups.setdefault(S, []).append(row)
+        for S in sorted(groups):
+            self._paged_prefill_group(groups[S], S, stats, injected_hang)
+        return True
+
+    def _paged_prefill_group(self, rows, S: int, stats: ServingStats,
+                             injected_hang: float) -> None:
+        """One compiled suffix-prefill call for rows sharing suffix bucket
+        ``S``; mirrors the non-paged batch prefill's telemetry, watchdog,
+        breaker, and containment discipline. A fault releases exactly this
+        group's slots (blocks freed before commit, so nothing leaks into
+        the radix index) and requeues each rider once."""
+        paged = self.pool.paged
+        tok = self.engine.tokenizer
+        cfg = self.engine.config
+        N = paged.num_blocks
+        nbl = paged.blocks_per_slot
+        nb = _bucket_pow2(len(rows), max(self.serving.prefill_group,
+                                         len(rows)))
+        tokens = np.full((nb, S), tok.pad_id, np.int32)
+        valid = np.zeros((nb, S), bool)
+        positions = np.zeros((nb, S), np.int32)
+        tables = np.zeros((nb, nbl), np.int32)
+        wtables = np.full((nb, nbl), N, np.int32)
+        cow_src = np.full((nb,), N, np.int32)
+        cow_dst = np.full((nb,), N, np.int32)
+        matched = np.zeros((nb,), np.int32)
+        slot_ids = np.full((nb,), self.num_slots, np.int32)
+        last_idx = np.zeros((nb,), np.int32)
+        for i, (req, ids, slot, plan, real_s) in enumerate(rows):
+            tokens[i, :real_s] = ids[plan.matched:]
+            valid[i, :real_s] = True
+            # Absolute positions (prefix at 0.. is what makes it shareable);
+            # the pad tail clamps inside the model's position tables.
+            positions[i] = np.minimum(plan.matched + np.arange(S),
+                                      cfg.max_seq_len - 1)
+            tables[i] = plan.table
+            wtables[i] = plan.write_table
+            cow_src[i], cow_dst[i] = plan.cow_src, plan.cow_dst
+            matched[i] = plan.matched
+            slot_ids[i] = slot
+            last_idx[i] = real_s - 1
+        # Batch-bucket pad rows: one valid token so softmax has mass (engine
+        # idiom); their write tables are all-drop and their slot id is out
+        # of range, so nothing they compute lands anywhere.
+        valid[len(rows):, 0] = True
+        guard = self._guard()
+        first_compile = ("paged_prefill", nb, S, guard) not in self._compiled
+        fn = self._paged_prefill_fn(nb, S, guard)
+        pf_t0 = time.monotonic()
+        for req, *_ in rows:
+            self.tracer.record(req.id, "prefill_start", t=pf_t0)
+        if self.watchdog is not None:
+            self.watchdog.arm("prefill")
+        try:
+            out = fn(
+                self.engine.params, self._arena, self._prev_logits,
+                jnp.asarray(tokens), jnp.asarray(valid),
+                jnp.asarray(positions), jnp.asarray(tables),
+                jnp.asarray(wtables), jnp.asarray(cow_src),
+                jnp.asarray(cow_dst), jnp.asarray(matched),
+                jnp.asarray(slot_ids), jnp.asarray(last_idx),
+            )
+            if guard:
+                new_arena, new_logits, finite = out
+                check_finite(finite, "serving", "prefill")
+            else:
+                new_arena, new_logits = out
+            self._arena, self._prev_logits = new_arena, new_logits
+            if self.watchdog is not None:
+                self.watchdog.observe("prefill", extra_s=injected_hang,
+                                      classify=not first_compile)
+        except Exception as e:  # noqa: BLE001 — containment is the point
+            kind = ("hang" if isinstance(e, HangFault)
+                    else "numerics" if isinstance(e, NumericsFault)
+                    else "device")
+            logger.warning("paged prefill group (%d, %d) failed: %s",
+                           nb, S, e)
+            get_registry().counter(
+                "faults_total", component="serving",
+                kind=kind, stage="prefill", **self.labels,
+            ).inc()
+            if self.breakers is not None:
+                self.breakers.record_failure("prefill")
+            for req, ids, slot, plan, real_s in rows:
+                self.pool.release(slot)
+                self._requeue_or_fail(req, f"prefill failed: {e}", stats,
+                                      cause=kind)
+            return
+        if self.breakers is not None:
+            self.breakers.record_success("prefill")
+        reg = get_registry()
+        for req, ids, slot, plan, real_s in rows:
+            # Commit AFTER the device call: the freshly-written full prompt
+            # blocks become matchable, and later rows (deferred above) find
+            # them in the index.
+            paged.commit(slot, ids)
+            reg.histogram(
+                "matched_prefix_len", component="paged_kv", **self.labels
+            ).observe(plan.matched)
+        pf_wall = time.monotonic() - pf_t0
+        reg.histogram(
+            "prefill_wall_s", component="serving", **self.labels
+        ).observe(pf_wall)
+        # Timeline span carries the per-prefill matched_prefix_len total, so
+        # the attribution layer (PR 7) can see prefill work disappear.
+        get_timeline().record_span(
+            f"prefill[{nb}x{S}]", "prefill", self._track, pf_t0, pf_wall,
+            rows=len(rows), matched_prefix_tokens=int(matched.sum()),
+        )
+        if first_compile:
+            record_compile("paged_prefill", reason="shape", seconds=pf_wall,
+                           track=self._track,
+                           key=("paged_prefill", nb, S, guard),
+                           labels=self.labels, t0=pf_t0)
+        stats.prefill_batches += 1
+        # Suffix tokens only: the hit/miss counters hold the reuse story,
+        # and this total IS the measured prefill-token reduction.
+        stats.prefill_tokens += sum(r[4] for r in rows)
+        stats.admitted += len(rows)
+
     def _decode(self, stats: ServingStats) -> bool:
         """One compiled decode chunk over the live slots; evict finished
         rows. Returns True when any decoding happened."""
@@ -1256,7 +1635,9 @@ class ContinuousScheduler:
         # Released-slot invalidation rides on the step program's reset mask
         # (no separate dispatch). Slots released and REUSED before this
         # point never enter the mask — SlotPool.alloc cancels their pending
-        # invalidation because prefill re-initialized the row.
+        # invalidation because prefill re-initialized the row. (Paged mode
+        # has no reset mask at all: a released BLOCK re-enters a table only
+        # through a prefill that cleared its key_valid in-program.)
         reset = np.zeros((self.num_slots,), bool)
         reset[self.pool.take_invalidations()] = True
 
@@ -1275,24 +1656,48 @@ class ContinuousScheduler:
             seed = st.request.row_seed
             seeds[slot] = np.uint32((0 if seed is None else seed) & 0xFFFFFFFF)
         guard = self._guard()
-        first_compile = ("serve_step", self.decode_chunk, guard) \
-            not in self._compiled
-        fn = self._step_fn()
+        step_key = (("paged_step" if self.paged else "serve_step"),
+                    self.decode_chunk, guard)
+        first_compile = step_key not in self._compiled
+        if self.paged:
+            paged = self.pool.paged
+            tables = np.zeros((B, paged.blocks_per_slot), np.int32)
+            wtables = np.full((B, paged.blocks_per_slot),
+                              paged.num_blocks, np.int32)
+            for slot in live_ids:
+                tables[slot] = paged.table_for(slot)
+                wtables[slot] = paged.write_table_for(slot)
+            fn = self._paged_step_fn()
+        else:
+            fn = self._step_fn()
         dc_t0 = time.monotonic()
         if self.watchdog is not None:
             self.watchdog.arm("decode")
         try:
-            out = fn(
-                self.engine.params, self._cache, self._prev_logits,
-                jnp.asarray(seeds), jnp.asarray(emitted), jnp.asarray(base),
-                jnp.asarray(caps), jnp.asarray(live), jnp.asarray(reset),
-            )
+            if self.paged:
+                out = fn(
+                    self.engine.params, self._arena, self._prev_logits,
+                    jnp.asarray(tables), jnp.asarray(wtables),
+                    jnp.asarray(seeds), jnp.asarray(emitted),
+                    jnp.asarray(base), jnp.asarray(caps), jnp.asarray(live),
+                )
+            else:
+                out = fn(
+                    self.engine.params, self._cache, self._prev_logits,
+                    jnp.asarray(seeds), jnp.asarray(emitted),
+                    jnp.asarray(base), jnp.asarray(caps), jnp.asarray(live),
+                    jnp.asarray(reset),
+                )
             if guard:
-                (self._cache, self._prev_logits, toks, emitted_after,
+                (new_kv, self._prev_logits, toks, emitted_after,
                  counters, finite) = out
             else:
-                self._cache, self._prev_logits, toks, emitted_after, \
+                new_kv, self._prev_logits, toks, emitted_after, \
                     counters = out
+            if self.paged:
+                self._arena = new_kv
+            else:
+                self._cache = new_kv
             toks = np.asarray(jax.device_get(toks))
             emitted_after = np.asarray(jax.device_get(emitted_after))
             counters = np.asarray(jax.device_get(counters))
@@ -1330,9 +1735,20 @@ class ContinuousScheduler:
             # Every live slot was just released, so nothing in the cache is
             # still needed — rebuild device state from scratch (with TPU
             # buffer donation, a raised call may have consumed the inputs).
-            self._cache = init_cache(
-                self.engine.config, self.num_slots, self.cache_len
-            )
+            # Paged: the arena rebuild zeroes every cached prefix too, so
+            # the radix index and block accounting must forget them —
+            # matching a tree node whose block was zeroed would silently
+            # serve a blank prefix.
+            if self.paged:
+                self._arena = init_arena(
+                    self.engine.config, self.pool.paged.num_blocks,
+                    self.serving.kv_block_size, self.num_slots,
+                )
+                self.pool.paged.reset()
+            else:
+                self._cache = init_cache(
+                    self.engine.config, self.num_slots, self.cache_len
+                )
             self._prev_logits = jnp.zeros_like(self._prev_logits)
             self.pool.take_invalidations()
             return True
@@ -1354,12 +1770,12 @@ class ContinuousScheduler:
                                     labels=self.labels, rows=len(live_ids))
         if first_compile:
             record_compile(
-                "serve_step",
+                step_key[0],
                 reason=("decode_chunk"
                         if self.decode_chunk != self._base_decode_chunk
                         else "shape"),
                 seconds=dc_wall, track=self._track,
-                key=("serve_step", self.decode_chunk, guard),
+                key=step_key,
                 labels=self.labels, t0=dc_t0,
             )
         observe_decode(

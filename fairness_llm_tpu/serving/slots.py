@@ -1,14 +1,19 @@
 """Fixed-size KV slot pool: the host-side allocator behind continuous batching.
 
-The device holds ONE persistent cache of ``num_slots`` rows (allocated once,
-shaped [num_slots, cache_len] per layer — see ``scheduler.py``); this module
-tracks which rows are live, what request occupies each, and the per-slot
-layout the decode step needs:
+The device holds ONE persistent cache (allocated once — see ``scheduler.py``):
+either ``num_slots`` private rows shaped [num_slots, cache_len] per layer, or
+— with ``--paged-kv`` — a shared block arena the pool's :class:`PagedKV`
+manager maps slots into through per-slot block tables (``serving/paged.py``).
+This module tracks which slots are live, what request occupies each, and the
+per-slot layout the decode step needs:
 
-- ``base``: the prompt bucket the row was PREFILLED at (its admission
-  batch's max bucket) — decode step t writes its KV at slot
-  ``base + emitted`` (the engine's per-row ``write_offsets`` machinery from
-  the speculative-decoding PR, promoted to the serving path)
+- ``base``: the first decode write offset — the prompt bucket the row was
+  PREFILLED at in the private-row layout (its admission batch's max bucket),
+  or the REAL prompt length in the paged layout (paged rows are not
+  left-padded; the prefix must sit at absolute positions to be shareable).
+  Decode step t writes its KV at slot ``base + emitted`` (the engine's
+  per-row ``write_offsets`` machinery from the speculative-decoding PR,
+  promoted to the serving path)
 - ``real_len``: real (non-pad) prompt tokens — RoPE/learned positions
   continue from here, exactly as a batch-1 ``DecodeEngine.generate`` would
 - ``emitted``: generated tokens so far (incl. a stopping EOS)
@@ -17,7 +22,10 @@ Free slots form an explicit free list (lowest id first, deterministic);
 ``release`` returns the slot and marks it for device-side invalidation —
 the scheduler zeroes the row's ``key_valid``/``lengths`` before the next
 decode step, so a recycled slot can never attend to its previous tenant's
-keys even transiently.
+keys even transiently. In paged mode the discipline moves from rows to
+blocks: ``release`` routes through ``PagedKV.release`` (deref the radix
+chain, free the private blocks) and a recycled block is only ever reachable
+through a table whose prefill program cleared its ``key_valid`` first.
 """
 
 from __future__ import annotations
@@ -26,29 +34,39 @@ import dataclasses
 import heapq
 from typing import Dict, List, Optional
 
+from fairness_llm_tpu.serving.paged import PagedKV
 from fairness_llm_tpu.serving.request import Request
 
 
 @dataclasses.dataclass
 class SlotState:
     request: Request
-    base: int  # bucketed prompt length = first decode write offset
+    base: int  # first decode write offset (see module docstring)
     real_len: int  # real prompt tokens (position origin for decode)
     emitted: int = 0  # generated tokens so far
     tokens: List[int] = dataclasses.field(default_factory=list)
 
 
 class SlotPool:
-    def __init__(self, num_slots: int):
+    def __init__(self, num_slots: int, paged: Optional[PagedKV] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.num_slots = num_slots
+        # Paged-KV block manager (serving/paged.py): when present, the pool
+        # owns the block tables — release frees/derefs blocks instead of
+        # queueing a row invalidation, and the scheduler plans admissions
+        # through ``paged.admit``/``commit``.
+        self.paged = paged
         self._free: List[int] = list(range(num_slots))
         heapq.heapify(self._free)
         self._live: Dict[int, SlotState] = {}
         # Slots released since the last invalidation flush; the scheduler
         # zeroes their device rows (key_valid/lengths) and clears this.
-        self.pending_invalidation: List[int] = []
+        # A dict used as an ordered set: membership/removal are O(1) in
+        # ``alloc`` (the old list paid an O(n) ``remove`` per recycled
+        # slot) while iteration keeps insertion order, so the flush stays
+        # deterministic. Exposed as a list property for readers.
+        self._pending_invalidation: Dict[int, None] = {}
 
     def __len__(self) -> int:
         return len(self._live)
@@ -60,6 +78,12 @@ class SlotPool:
     @property
     def occupancy(self) -> int:
         return len(self._live)
+
+    @property
+    def pending_invalidation(self) -> List[int]:
+        """Released-not-yet-invalidated slots, in release order (a read-only
+        view; mutation goes through alloc/release/take_invalidations)."""
+        return list(self._pending_invalidation)
 
     def live_slots(self) -> List[int]:
         return sorted(self._live)
@@ -78,19 +102,28 @@ class SlotPool:
         # fully re-initializes the row ([0, P) overwritten, [P:) key_valid
         # cleared), and a flush landing AFTER that prefill would wipe the
         # new tenant's prompt (caught by the recycled-slot parity test).
-        if slot in self.pending_invalidation:
-            self.pending_invalidation.remove(slot)
+        self._pending_invalidation.pop(slot, None)
         return slot
 
     def release(self, slot: int) -> SlotState:
-        """Free ``slot`` and queue it for device-side invalidation. Raises
-        KeyError for a slot that isn't live (double-release is a bug, not a
-        no-op — silent tolerance would mask allocator corruption)."""
+        """Free ``slot`` and queue it for device-side invalidation (private-
+        row mode) or release its blocks (paged mode). Raises KeyError for a
+        slot that isn't live (double-release is a bug, not a no-op — silent
+        tolerance would mask allocator corruption)."""
         state = self._live.pop(slot)
         heapq.heappush(self._free, slot)
-        self.pending_invalidation.append(slot)
+        if self.paged is not None:
+            # Block-granularity discipline: deref the shared radix chain
+            # (the nodes stay cached for future matches) and free the
+            # private tail. No row reset rides the next step — a freed
+            # block re-enters a table only through a prefill that clears
+            # its key_valid in-program first.
+            self.paged.release(slot)
+        else:
+            self._pending_invalidation[slot] = None
         return state
 
     def take_invalidations(self) -> List[int]:
-        out, self.pending_invalidation = self.pending_invalidation, []
+        out = list(self._pending_invalidation)
+        self._pending_invalidation.clear()
         return out

@@ -1,0 +1,485 @@
+"""Paged KV cache + radix-tree prefix reuse tests (ISSUE 10).
+
+The correctness contract is the same one every serving PR pins — token-for-
+token greedy parity with ``DecodeEngine.generate`` alone — now under the
+paged layout: shared prefix blocks, copy-on-write at the divergence point,
+LRU eviction of unreferenced radix leaves, and block recycling under slot
+churn (eviction + backfill + requeue-once + fleet migration). On top of
+that: host-side allocator/refcount invariants, the block-granularity
+invalidation discipline, and the prefix-cache metrics.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import (
+    FleetConfig,
+    IntegrityConfig,
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+)
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import (
+    ContinuousScheduler,
+    PagedKV,
+    RadixIndex,
+    ReplicaSet,
+    Request,
+    SlotPool,
+    SlotState,
+)
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+
+def greedy(m: int) -> ModelSettings:
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+PCFG = ServingConfig(
+    enabled=True, num_slots=2, queue_capacity=64,
+    max_prompt_len=192, max_new_tokens=32, decode_chunk=4,
+    paged_kv=True, kv_block_size=16,
+)
+
+# A counterfactual-shaped family: one long shared stem, tiny divergent
+# tails — the phase-1 regime the paged cache exists for. Byte-tokenized
+# lengths stay inside the 192-token serving budget (parity needs that).
+STEM = ("Recommend 5 movies. The user enjoyed Alien, Heat, Fargo, Clue, "
+        "Tron, Big, Jaws, Up. Genres: drama, thriller. Profile: ")
+FAMILY = [STEM + tail for tail in (
+    "male 18-24", "female 18-24", "nonbinary 18-24", "male 25-34",
+    "female 25-34", "nonbinary 25-34", "male 35-44", "female 35-44",
+)]
+
+MIXED = [
+    "the quick brown fox",
+    "hi",
+    "abc abc abc abc abc abc",
+    "a long prompt that shifts padding " * 5 + "and lands in a big bucket",
+    "zz",
+    "recommend ten films please",
+    "one two three one two three",
+]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+def _req(prompt, m=8, **kw):
+    return Request(prompt=prompt, settings=greedy(m), **kw)
+
+
+def _assert_engine_parity(engine, req, res):
+    assert res.ok, (res.id, res.finish_reason, res.error)
+    ref = engine.generate([req.prompt], req.settings)
+    n = len(res.tokens)
+    assert n > 0
+    np.testing.assert_array_equal(res.tokens, ref.tokens[0][:n])
+    assert np.all(ref.tokens[0][n:] == engine.tokenizer.pad_id)
+
+
+def _paged_invariant(paged: PagedKV):
+    """free + live-private + tree-owned == num_blocks, no id appears twice."""
+    tree_blocks = []
+    stack = [paged.index.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children.values():
+            tree_blocks.append(child.block)
+            stack.append(child)
+    private = [b for blocks in paged._private.values() for b in blocks]
+    everything = list(paged._free) + private + tree_blocks
+    assert len(everything) == len(set(everything)), "block id aliased"
+    assert len(everything) == paged.num_blocks, (
+        len(paged._free), len(private), len(tree_blocks), paged.num_blocks
+    )
+
+
+# -- radix index units --------------------------------------------------------
+
+
+def test_radix_match_insert_refcount():
+    idx = RadixIndex(4)
+    ids = list(range(10))  # blocks [0..3], [4..7]; tail 8,9
+    m = idx.match(ids)
+    assert m.nodes == [] and m.cow_len == 0
+    held, promoted = idx.insert(ids, [100, 101], m.nodes)
+    assert promoted == [100, 101] and len(held) == 2
+    assert all(n.refs == 1 for n in held)
+    # Second identical prompt: both full blocks match (9 tokens matchable).
+    m2 = idx.match(ids)
+    assert [n.block for n in m2.nodes] == [100, 101]
+    assert held[0].refs == 2 and held[1].refs == 2
+    idx.release(m2.nodes)
+    idx.release(held)
+    assert held[0].refs == 0 and held[1].refs == 0
+    assert len(idx) == 2  # unreferenced nodes stay CACHED
+
+
+def test_radix_match_caps_at_len_minus_one():
+    """A fully-cached prompt must still prefill >= 1 token (the sampler
+    needs last-token logits), so an exact-multiple prompt matches one
+    block short of everything."""
+    idx = RadixIndex(4)
+    ids = list(range(8))  # exactly two blocks
+    m0 = idx.match(ids)
+    held, _ = idx.insert(ids, [7, 8], m0.nodes)
+    m = idx.match(ids)
+    # only block 0 fully matches (7 matchable tokens); block 1 partial CoW
+    assert [n.block for n in m.nodes] == [7]
+    assert m.cow_src_block == 8 and m.cow_len == 3
+    assert m.matched(4) == 7 == len(ids) - 1
+    # match() pinned the CoW source too — it must be unevictable until the
+    # device copy lands (commit), so releasing a match means nodes + pin.
+    # refs == 2: the original inserter's held ref + this match's pin.
+    assert m.cow_node.refs == 2
+    idx.release(m.nodes + [m.cow_node])
+    idx.release(held)
+
+
+def test_radix_cow_partial_match():
+    idx = RadixIndex(4)
+    a = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    held, _ = idx.insert(a, [0, 1], idx.match(a).nodes)
+    b = [1, 2, 3, 4, 5, 6, 99, 98, 97]  # diverges inside block 1
+    m = idx.match(b)
+    assert [n.block for n in m.nodes] == [0]
+    assert m.cow_src_block == 1 and m.cow_len == 2  # tokens 5,6 shared
+    assert m.matched(4) == 6
+    # The pinned source must survive eviction pressure until released.
+    assert idx.evict_lru() is None
+    idx.release(m.nodes + [m.cow_node])
+    idx.release(held)
+
+
+def test_radix_evict_lru_leaf_first():
+    idx = RadixIndex(2)
+    a = [1, 2, 3, 4, 5]  # blocks [1,2], [3,4]
+    b = [1, 2, 9, 9, 9]  # shares block [1,2], own [9,9]
+    ha, _ = idx.insert(a, [10, 11], idx.match(a).nodes)
+    mb = idx.match(b)
+    hb, _ = idx.insert(b, [mb.nodes[0].block, 12], mb.nodes)
+    idx.release(ha)
+    idx.release(hb)
+    # Leaves are 11 ([3,4], older) and 12 ([9,9], newer); the shared root
+    # block 10 is interior and must outlive both.
+    assert idx.evict_lru() == 11
+    assert idx.evict_lru() == 12
+    assert idx.evict_lru() == 10
+    assert idx.evict_lru() is None and len(idx) == 0
+
+
+def test_radix_evict_skips_referenced():
+    idx = RadixIndex(2)
+    a = [1, 2, 3, 4, 5]
+    held, _ = idx.insert(a, [0, 1], idx.match(a).nodes)
+    assert idx.evict_lru() is None  # both nodes referenced
+    idx.release(held)
+    assert idx.evict_lru() == 1
+
+
+# -- PagedKV allocator --------------------------------------------------------
+
+
+def test_paged_kv_admit_commit_release_accounting():
+    paged = PagedKV(num_slots=2, blocks_per_slot=4, block_size=4)
+    ids = list(range(14))  # 3 full blocks + tail
+    plan = paged.admit(0, ids)
+    assert plan is not None and plan.matched == 0
+    assert len(plan.table) == 4 and plan.cow_src == paged.num_blocks
+    paged.commit(0, ids)
+    _paged_invariant(paged)
+    # Twin admission shares the 3 full blocks... but only 13 tokens are
+    # matchable, so blocks 0-2 (12 tokens) share + 1 CoW-free token.
+    plan2 = paged.admit(1, ids)
+    assert plan2 is not None
+    assert plan2.table[:3] == plan.table[:3]  # shared prefix blocks
+    assert plan2.matched >= 12
+    # Shared entries in the write table must DROP (out of range).
+    assert all(w == paged.num_blocks for w in plan2.write_table[:3])
+    paged.commit(1, ids)
+    _paged_invariant(paged)
+    # Releasing one twin must not free the other's shared blocks.
+    paged.release(0)
+    _paged_invariant(paged)
+    assert all(b not in paged._free for b in plan2.table[:3])
+    m = paged.index.match(ids)
+    assert [n.block for n in m.nodes] == plan2.table[:3]
+    paged.index.release(m.nodes)
+    paged.release(1)
+    _paged_invariant(paged)
+    # Everything released: the full blocks stay cached in the tree.
+    assert paged.index.cached_blocks() == 3
+
+
+def test_paged_kv_exhaustion_and_eviction():
+    paged = PagedKV(num_slots=2, blocks_per_slot=4, block_size=4,
+                    num_blocks=5)
+    a = list(range(10))
+    assert paged.admit(0, a) is not None
+    paged.commit(0, a)
+    # 4 blocks live-private/tree, 1 free: a disjoint second prompt cannot
+    # fit 4 private blocks while slot 0 holds refs.
+    b = list(range(100, 110))
+    assert paged.admit(1, b) is None
+    _paged_invariant(paged)
+    paged.release(0)
+    # Now the cached (unreferenced) blocks of A evict LRU to make room.
+    with use_registry() as reg:
+        plan_b = paged.admit(1, b)
+        assert plan_b is not None
+        ev = reg.peek("kv_blocks_evicted_total", component="paged_kv")
+        assert ev is not None and ev.value >= 1
+    paged.commit(1, b)
+    _paged_invariant(paged)
+    paged.release(1)
+
+
+def test_cow_source_pinned_until_commit():
+    """The eviction race regression: between planning an admission and its
+    device prefill, ANOTHER admission's eviction must not free the first's
+    copy-on-write source block (it would be reallocated and rewritten
+    before the copy reads it). match() pins the source; the pin drops at
+    commit."""
+    paged = PagedKV(num_slots=2, blocks_per_slot=3, block_size=4,
+                    num_blocks=6)
+    p1 = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    assert paged.admit(0, p1) is not None
+    paged.commit(0, p1)
+    paged.release(0)  # two cached nodes, three free blocks
+    p2 = [1, 2, 3, 4, 5, 6, 99, 98, 97]  # shares blk0, CoW inside blk1
+    plan2 = paged.admit(0, p2)
+    assert plan2 is not None and plan2.cow_src < paged.num_blocks
+    # A disjoint admission needing eviction must BACKPRESSURE, not evict
+    # the pinned CoW source out from under the planned copy.
+    p3 = [50, 51, 52, 53, 54, 55, 56, 57, 58]
+    assert paged.admit(1, p3) is None
+    assert paged._cow[0].refs == 1  # the pin is what protected the source
+    paged.commit(0, p2)  # copy landed -> pin drops -> source evictable
+    assert 0 not in paged._cow
+    assert paged.admit(1, p3) is not None
+    _paged_invariant(paged)
+    paged.release(0)
+    paged.release(1)
+    _paged_invariant(paged)
+
+
+def test_slot_pool_routes_release_through_paged():
+    paged = PagedKV(num_slots=2, blocks_per_slot=4, block_size=4)
+    pool = SlotPool(2, paged=paged)
+    s = pool.alloc(SlotState(request=Request(prompt="x"), base=5, real_len=5))
+    ids = list(range(5))
+    assert paged.admit(s, ids) is not None
+    paged.commit(s, ids)
+    pool.release(s)
+    assert paged.table_for(s) is None
+    # Paged mode: no row-reset rides the next step.
+    assert pool.pending_invalidation == []
+    _paged_invariant(paged)
+
+
+def test_pending_invalidation_is_o1_and_ordered():
+    """The satellite: dict-backed pending set keeps deterministic (release-
+    order) flush while alloc's cancellation is O(1)."""
+    pool = SlotPool(4)
+    for i in range(4):
+        pool.alloc(SlotState(request=Request(prompt=f"p{i}"), base=1,
+                             real_len=1))
+    pool.release(2)
+    pool.release(0)
+    pool.release(3)
+    assert pool.pending_invalidation == [2, 0, 3]  # release order, not id
+    assert pool.alloc(SlotState(request=Request(prompt="r"), base=1,
+                                real_len=1)) == 0
+    assert pool.pending_invalidation == [2, 3]
+    assert pool.take_invalidations() == [2, 3]
+    assert pool.pending_invalidation == []
+
+
+# -- serving parity -----------------------------------------------------------
+
+
+def test_paged_server_matches_engine_greedy_mixed_lengths(engine):
+    sched = ContinuousScheduler(engine, PCFG, settings=greedy(16))
+    reqs = [_req(p, m=8 + 2 * (i % 5)) for i, p in enumerate(MIXED)]
+    results = sched.serve(reqs)
+    for req, res in zip(reqs, results):
+        _assert_engine_parity(engine, req, res)
+
+
+def test_paged_parity_shared_prefix_churn_and_requeue(engine):
+    """The defining workload through a scarce arena: 8 near-duplicate
+    prompts through 2 slots with only ~1.5 slots' worth of blocks, plus a
+    mid-sweep decode fault — eviction, backfill, block recycling, and a
+    requeue-once all compose, and every token still matches the engine."""
+    bps = ContinuousScheduler(engine, PCFG,
+                              settings=greedy(8)).pool.paged.blocks_per_slot
+    # One slot's worth + 2: admissions serialize behind block backpressure
+    # and freed blocks recycle constantly (eviction itself is unit-covered
+    # in test_paged_kv_exhaustion_and_eviction — the mid-run fault below
+    # resets the index, so demanding an eviction here would race it).
+    scarce = dataclasses.replace(PCFG, kv_blocks=bps + 2)
+    inj = ScriptedFaultInjector({("fam3", "decode"): 1})
+    with use_registry():
+        sched = ContinuousScheduler(engine, scarce, settings=greedy(8),
+                                    fault_injector=inj)
+        reqs = [_req(p, m=8, id=f"fam{i}") for i, p in enumerate(FAMILY)]
+        results = sched.serve(reqs)
+        for req, res in zip(reqs, results):
+            _assert_engine_parity(engine, req, res)
+        assert results[3].retries == 1  # the fault requeued once
+        _paged_invariant(sched.pool.paged)
+
+
+def test_paged_parity_independent_of_pool_composition(engine):
+    target = FAMILY[2]
+    alone = ContinuousScheduler(engine, PCFG, settings=greedy(12)).serve(
+        [_req(target, m=12)]
+    )[0]
+    crowd = [_req(p, m=6) for p in MIXED[:2]] + [_req(target, m=12)] + \
+        [_req(p, m=10) for p in FAMILY[:3]]
+    crowded = ContinuousScheduler(engine, PCFG, settings=greedy(12)).serve(
+        crowd
+    )[2]
+    np.testing.assert_array_equal(alone.tokens, crowded.tokens)
+
+
+def test_paged_cow_at_divergence_never_mutates_source(engine):
+    """Two prompts diverging mid-block force a copy-on-write; serving the
+    first prompt AGAIN afterwards must reproduce the engine exactly — if
+    the CoW had mutated the shared source block in place, the re-serve
+    would decode the second prompt's tokens through the first's prefix."""
+    a, b = FAMILY[0], FAMILY[1]
+    with use_registry() as reg:
+        sched = ContinuousScheduler(engine, PCFG, settings=greedy(8))
+        res_a = sched.serve([_req(a)])[0]
+        _assert_engine_parity(engine, _req(a), res_a)
+        res_b = sched.serve([_req(b)])[0]
+        _assert_engine_parity(engine, _req(b), res_b)
+        cow = reg.peek("prefix_cache_cow_total", component="paged_kv")
+        assert cow is not None and cow.value >= 1, \
+            "divergence inside a block must copy-on-write"
+        res_a2 = sched.serve([_req(a)])[0]
+        np.testing.assert_array_equal(res_a2.tokens, res_a.tokens)
+        res_b2 = sched.serve([_req(b)])[0]
+        np.testing.assert_array_equal(res_b2.tokens, res_b.tokens)
+
+
+def test_paged_twin_release_keeps_shared_blocks_readable(engine):
+    """Refcount safety end-to-end: pair members with staggered budgets —
+    the short one finishes and releases while its twin still decodes
+    through the shared prefix blocks. The twin's tokens must not change."""
+    sched = ContinuousScheduler(engine, PCFG, settings=greedy(24))
+    reqs = [_req(FAMILY[0], m=2), _req(FAMILY[1], m=24)]
+    results = sched.serve(reqs)
+    for req, res in zip(reqs, results):
+        _assert_engine_parity(engine, req, res)
+
+
+def test_paged_hit_rate_counterfactual_shape(engine):
+    """The acceptance shape: a phase-1-like family must push the hit ratio
+    past 0.5 (the CI gate; the bench pushes past 0.8 with more variants),
+    with hit tokens visible in the process counters."""
+    with use_registry() as reg:
+        sched = ContinuousScheduler(engine, PCFG, settings=greedy(8))
+        results = sched.serve([_req(p) for p in FAMILY])
+        assert all(r.ok for r in results)
+        paged = sched.pool.paged
+        assert paged.hit_ratio > 0.5, paged.hit_ratio
+        hit = reg.peek("prefix_cache_hit_tokens_total", component="paged_kv")
+        assert hit is not None and hit.value > 0
+        gauge = reg.peek("prefix_cache_hit_ratio", component="paged_kv")
+        assert gauge is not None and gauge.value == pytest.approx(
+            paged.hit_ratio
+        )
+
+
+def test_paged_numerics_guard_and_corruption_containment(engine):
+    """The integrity layer composes: guarded paged programs compile and a
+    scripted NaN corruption is contained as a requeue, parity held."""
+    engine.numerics_guards = True
+    try:
+        inj = ScriptedFaultInjector({}, corruptions={("fam1", "decode"): 1})
+        sched = ContinuousScheduler(
+            engine, PCFG, settings=greedy(8), fault_injector=inj,
+            resilience=ResilienceConfig(enabled=True),
+        )
+        reqs = [_req(p, m=8, id=f"fam{i}") for i, p in enumerate(FAMILY[:4])]
+        results = sched.serve(reqs)
+        for req, res in zip(reqs, results):
+            _assert_engine_parity(engine, req, res)
+        # The corrupted chunk rebuilt the arena; the index forgot the
+        # zeroed prefixes and the allocator is whole again.
+        _paged_invariant(sched.pool.paged)
+    finally:
+        engine.numerics_guards = False
+
+
+def test_paged_fleet_migration_parity(engine):
+    """Fleet failover over paged replicas: kill r1 mid-sweep — zero lost,
+    migrated survivors token-identical through r0's own paged pool."""
+    inj = ScriptedFaultInjector(replica_crashes={"r1": 3})
+    fleet = ReplicaSet(
+        engine, PCFG, settings=greedy(8),
+        fleet=FleetConfig(replicas=2, fence_cooldown_s=0.02),
+        resilience=ResilienceConfig(enabled=True, breaker_threshold=1,
+                                    breaker_cooldown_s=0.01),
+        fault_injector=inj, integrity=IntegrityConfig(canary_max_tokens=8),
+    )
+    reqs = [_req(p, m=8, id=f"fam{i}") for i, p in enumerate(FAMILY)]
+    results = fleet.serve(reqs)
+    for req, res in zip(reqs, results):
+        _assert_engine_parity(engine, req, res)
+    assert inj.replica_faults_fired == [("r1", "replica_crash")]
+
+
+def test_paged_scheduler_reusable_across_serves(engine):
+    sched = ContinuousScheduler(engine, PCFG, settings=greedy(8))
+    first = sched.serve([_req(FAMILY[0])])[0]
+    ratio0 = sched.pool.paged.hit_ratio
+    second = sched.serve([_req(FAMILY[0])])[0]
+    np.testing.assert_array_equal(first.tokens, second.tokens)
+    assert sched.pool.paged.hit_ratio > ratio0  # the repeat hit the cache
+
+
+# -- prompt layout satellites -------------------------------------------------
+
+
+def test_recommendation_prompt_pairs_diverge_late():
+    """The layout contract the hit rate rides on: counterfactual pairs
+    share most of their bytes as a prefix (demographics last)."""
+    from fairness_llm_tpu.data.profiles import Profile
+    from fairness_llm_tpu.pipeline.prompts import (
+        divergence_stats,
+        recommendation_prompt,
+    )
+
+    movies = [f"Movie {i}" for i in range(10)]
+    pairs = []
+    for g1, g2 in (("male", "female"), ("female", "non-binary")):
+        a = Profile(id="a", gender=g1, age="25-34", occupation="pro",
+                    watched_movies=movies, favorite_genres=["drama"])
+        b = Profile(id="b", gender=g2, age="25-34", occupation="pro",
+                    watched_movies=movies, favorite_genres=["drama"])
+        pairs.append((recommendation_prompt(a), recommendation_prompt(b)))
+    stats = divergence_stats(pairs)
+    assert stats["pairs"] == 2
+    assert stats["min_frac"] > 0.7, stats
+
+
+def test_divergence_stats_math():
+    from fairness_llm_tpu.pipeline.prompts import divergence_stats, lcp_len
+
+    assert lcp_len("abcd", "abXd") == 2
+    assert lcp_len("abc", "abc") == 3
+    s = divergence_stats([("aaaa", "aaXX"), ("bb", "bb")])
+    assert s["min_frac"] == pytest.approx(0.5)
+    assert s["max_frac"] == pytest.approx(1.0)
+    assert divergence_stats([])["pairs"] == 0

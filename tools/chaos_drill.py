@@ -44,7 +44,15 @@ What it does, in one process, deterministically:
    with their members' serving events attributed (the requeues the
    injected faults caused); the rendered fairness report is written
    beside the snapshot (``fairness_report.txt``) for failure evidence;
-9. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
+9. drills the PAGED KV CACHE (ISSUE 10): a counterfactual-shaped prompt
+   family through a ``--paged-kv`` scheduler with a scarce block arena —
+   a mid-sweep decode fault requeues a request whose prefix blocks are
+   SHARED with a live twin; asserting zero lost, every survivor
+   token-identical to the engine baseline (a stale or wrongly-freed
+   block would corrupt a survivor's tokens), the requeue re-admitted
+   through the radix index (nonzero hit tokens), and block accounting
+   whole at drain;
+10. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
    lost), survivors token-for-token equal to the baseline (zero corrupt
    records — the NaN chunk was retried, not delivered), the breaker cycle
    + hang + numerics fault + manifest failure + canary mismatch + fleet
@@ -507,6 +515,70 @@ def main() -> int:
                 events=[{"kind": "fairness_pair_divergent", **d}
                         for d in mon.divergent],
             ) + "\n")
+
+    # 9. Paged KV prefix reuse under faults (ISSUE 10): the defining
+    # workload shape — near-duplicate prompts sharing a long prefix —
+    # through a paged scheduler with a scarce arena (~1 slot's worth + 2
+    # blocks, so block recycling is constant), with a mid-sweep decode
+    # fault hitting a request whose prefix blocks are SHARED with its
+    # live twin. The fault releases the victim's slot (derefs the shared
+    # chain) while the twin keeps decoding through the same blocks; the
+    # requeue re-admits through the radix index. Parity against the
+    # static engine is the no-stale-block-reads proof.
+    import dataclasses as _dc
+
+    paged_cfg = _dc.replace(SERVING, paged_kv=True, kv_block_size=16)
+    probe_sched = ContinuousScheduler(engine, paged_cfg, settings=GREEDY)
+    scarce_blocks = probe_sched.pool.paged.blocks_per_slot + 2
+    del probe_sched  # existed only to read blocks_per_slot; free its arena
+    paged_cfg = _dc.replace(paged_cfg, kv_blocks=scarce_blocks)
+    stem = ("recommend five movies for a user who enjoyed Alien, Heat, "
+            "Fargo, Tron and likes thrillers; profile ")
+    fam = [stem + t for t in ("male 18-24", "female 18-24", "male 25-34",
+                              "female 25-34", "male 35-44", "female 35-44")]
+    paged_baseline = {
+        f"paged{i}": np.asarray(engine.generate([p], GREEDY).tokens[0])
+        for i, p in enumerate(fam)
+    }
+    # paged1's prefix is shared with paged0 (served just before it) — the
+    # fault lands while those blocks are cached/refcounted.
+    paged_inj = ScriptedFaultInjector(faults={("paged1", "decode"): 1})
+    paged_sched = ContinuousScheduler(engine, paged_cfg, settings=GREEDY,
+                                      fault_injector=paged_inj)
+    paged_res = {r.id: r for r in paged_sched.serve(
+        [Request(prompt=p, id=f"paged{i}", settings=GREEDY)
+         for i, p in enumerate(fam)]
+    )}
+    check(len(paged_res) == len(fam)
+          and all(r.ok for r in paged_res.values()),
+          "paged chaos: zero lost under mid-sweep fault + scarce arena")
+    paged_parity = all(
+        np.array_equal(np.asarray(r.tokens),
+                       paged_baseline[rid][:len(r.tokens)])
+        and np.all(paged_baseline[rid][len(r.tokens):]
+                   == engine.tokenizer.pad_id)
+        for rid, r in paged_res.items()
+    )
+    check(paged_parity,
+          "paged chaos: survivors token-identical (no stale-block reads)")
+    check(paged_res["paged1"].retries == 1,
+          "paged chaos: shared-prefix victim requeued exactly once")
+    pkv = paged_sched.pool.paged
+    check(pkv._hit_tokens > 0 and pkv.hit_ratio > 0.5,
+          f"paged chaos: radix cache hit through the churn "
+          f"(ratio {pkv.hit_ratio:.2f})")
+    tree_blocks = 0
+    stack = [pkv.index.root]
+    while stack:
+        node = stack.pop()
+        for child in node.children.values():
+            tree_blocks += 1
+            stack.append(child)
+    check(pkv.free_blocks + tree_blocks == pkv.num_blocks
+          and not pkv._private,
+          "paged chaos: block accounting whole at drain "
+          f"(free {pkv.free_blocks} + cached {tree_blocks} "
+          f"== {pkv.num_blocks})")
 
     snap = T.snapshot(T.get_registry())
     # Unlabeled entries only: the fleet section's per-replica boards write

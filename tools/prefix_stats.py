@@ -1,0 +1,155 @@
+"""Pre-run prefix-reuse report for a sweep's prompt set.
+
+Builds the phase-1-shaped prompt set (profile grid -> counterfactual
+recommendation prompts, optionally the phase-3 fairness-aware variants),
+tokenizes it, and SIMULATES the paged KV cache's radix index over the
+prompts in sweep order — so the expected ``--paged-kv`` hit rate, the
+longest-common-prefix histogram, and the block-size sensitivity are all
+inspectable BEFORE paying for a run.
+
+Usage:
+    python tools/prefix_stats.py                  # stock grid, block 16
+    python tools/prefix_stats.py --profiles 5 --block-size 32
+    python tools/prefix_stats.py --phase 3 --variant smart
+    python tools/prefix_stats.py --json stats.json
+
+The simulation is exact for an arena large enough to never evict (every
+prompt's full blocks stay cached); a real run with a tight ``--kv-blocks``
+can only hit less. Tokenization is byte-level (``ByteTokenizer``) — real
+checkpoints tokenize coarser, which SHIFTS absolute token counts but
+barely moves the shared FRACTION (the quantity the hit rate rides on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fairness_llm_tpu.config import default_config  # noqa: E402
+from fairness_llm_tpu.data.movielens import load_movielens  # noqa: E402
+from fairness_llm_tpu.data.profiles import (  # noqa: E402
+    create_base_preferences,
+    create_profile_grid,
+    profile_pairs,
+)
+from fairness_llm_tpu.models.tokenizer import ByteTokenizer  # noqa: E402
+from fairness_llm_tpu.pipeline.prompts import (  # noqa: E402
+    divergence_stats,
+    fairness_aware_prompt,
+    recommendation_prompt,
+)
+from fairness_llm_tpu.serving.paged import RadixIndex  # noqa: E402
+
+
+def simulate_radix(token_rows, block_size: int):
+    """Replay the sweep order through a real RadixIndex (unbounded arena):
+    per-prompt matched tokens exactly as ``PagedKV.admit`` would compute
+    them (full shared blocks + the copy-on-write lead, capped at len-1)."""
+    index = RadixIndex(block_size)
+    next_block = 0
+    matched_per_prompt = []
+    for ids in token_rows:
+        m = index.match(ids)
+        matched = m.matched(block_size)
+        matched_per_prompt.append(matched)
+        n_full = len(ids) // block_size
+        blocks = [n.block for n in m.nodes]
+        while len(blocks) < n_full:
+            blocks.append(next_block)
+            next_block += 1
+        held, _ = index.insert(ids, blocks, m.nodes)
+        index.release(held)  # sweep rows release as they finish
+        if m.cow_node is not None:
+            index.release([m.cow_node])  # drop the CoW-source pin
+    return matched_per_prompt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--phase", type=int, choices=(1, 3), default=1)
+    ap.add_argument("--profiles", type=int, default=None,
+                    help="profiles per demographic combo (default: config)")
+    ap.add_argument("--variant", default="conformal",
+                    choices=("conformal", "smart", "aggressive"),
+                    help="phase-3 prompt variant")
+    ap.add_argument("--strategy", default="demographic_parity")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="KV block size to simulate (the sharing granularity)")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write the stats as JSON")
+    args = ap.parse_args()
+
+    config = default_config()
+    data = load_movielens(args.data_dir or config.data_dir,
+                          seed=config.random_seed)
+    base = create_base_preferences(data, seed=config.random_seed)
+    profiles = create_profile_grid(base, config, args.profiles)
+    if args.phase == 1:
+        prompts = [recommendation_prompt(p) for p in profiles]
+    else:
+        anonymize = args.variant in ("smart", "aggressive")
+        prompts = [
+            fairness_aware_prompt(
+                recommendation_prompt(p, anonymize=anonymize),
+                args.strategy if args.variant == "conformal"
+                else "individual_fairness",
+                aggressive=(args.variant == "aggressive"),
+            )
+            for p in profiles
+        ]
+
+    by_id = dict(zip((p.id for p in profiles), prompts))
+    pair_stats = divergence_stats(
+        [(by_id[a], by_id[b]) for a, b in profile_pairs(profiles)]
+    )
+
+    tok = ByteTokenizer(512)
+    rows = [tok.encode(p) for p in prompts]
+    matched = simulate_radix(rows, args.block_size)
+    total = sum(len(r) for r in rows)
+    hit = sum(matched)
+    fracs = [m / len(r) for m, r in zip(matched, rows)]
+    hist = Counter(int(f * 10) / 10 for f in fracs)
+
+    stats = {
+        "phase": args.phase,
+        "num_prompts": len(prompts),
+        "block_size": args.block_size,
+        "total_tokens": total,
+        "matched_tokens": hit,
+        "expected_hit_ratio": hit / total if total else 0.0,
+        "pair_divergence": pair_stats,
+        "matched_fraction_histogram": {
+            f"{k:.1f}": hist[k] for k in sorted(hist)
+        },
+    }
+    print(f"prompts: {len(prompts)}   block size: {args.block_size}   "
+          f"tokens: {total}")
+    print(f"counterfactual pairs: {pair_stats['pairs']}   shared-prefix "
+          f"fraction min/mean/max: {pair_stats['min_frac']:.3f} / "
+          f"{pair_stats['mean_frac']:.3f} / {pair_stats['max_frac']:.3f}")
+    print(f"expected --paged-kv hit ratio (sweep order, no eviction): "
+          f"{stats['expected_hit_ratio']:.3f}")
+    print("matched-prefix fraction histogram (per prompt):")
+    n = len(prompts)
+    for k in sorted(hist):
+        bar = "#" * max(1, round(40 * hist[k] / n))
+        print(f"  {k:>4.1f}-{k + 0.1:.1f}  {hist[k]:5d}  {bar}")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(stats, f, indent=2)
+        print(f"wrote {args.json}")
+    # The layout contract: pairs must share most of their bytes, or the
+    # defining workload has nothing for the prefix cache to reuse.
+    return 0 if (not pair_stats["pairs"]
+                 or pair_stats["min_frac"] >= 0.5) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
