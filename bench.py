@@ -1134,6 +1134,120 @@ def measure_prefix_cache(engine, prompts, settings_cls) -> dict | None:
     return out
 
 
+def measure_capacity(engine, prompts, settings_cls) -> dict | None:
+    """Capacity planning: the SAME seeded trace replayed against fixed
+    fleets of 1 -> 3 replicas (ISSUE 11).
+
+    One deterministic synthetic trace (diurnal curve + one burst +
+    heavy-tailed sessions + mixed QoS, ``serving/replay.py``) is replayed
+    time-compressed at each fleet size, best-of-3 per size in one process
+    (CPU-harness ±30-60% single-run jitter, docs/PERFORMANCE.md
+    methodology). Reported per size: profiles/sec and profiles/sec/CHIP
+    (each replica models one chip's slot pool), interactive TTFT
+    attainment against a fixed target, and the shed rate — the table an
+    operator reads to pick a fleet size for an offered load. Token parity
+    across fleet sizes is asserted on the completed intersection (routing
+    and fleet size must never change the tokens)."""
+    import dataclasses
+
+    from fairness_llm_tpu.config import (
+        FleetConfig,
+        IntegrityConfig,
+        OverloadConfig,
+        ResilienceConfig,
+        ServingConfig,
+    )
+    from fairness_llm_tpu.serving import (
+        ReplayDriver,
+        ReplicaSet,
+        TraceConfig,
+        generate_trace,
+    )
+    from fairness_llm_tpu.telemetry.slo import SLOTargets, set_slo_targets
+
+    compression = 4.0
+    ttft_target_s = 2.0
+    tcfg = TraceConfig(
+        seed=17, duration_s=24.0, base_sessions_per_s=0.8,
+        diurnal_amplitude=0.5, diurnal_period_s=24.0,
+        bursts=((8.0, 6.0, 5.0),), session_tail_alpha=1.3,
+        session_max_turns=3, think_time_s=2.0, interactive_frac=0.75,
+        max_tokens_choices=(8, 12, 16),
+    )
+    catalog = tuple(prompts[:8])
+    events = generate_trace(tcfg, catalog)
+    budget = max(tcfg.max_tokens_choices)
+
+    def greedy(m):
+        return _greedy(settings_cls, m)
+
+    scfg = ServingConfig(
+        enabled=True, num_slots=4, queue_capacity=32, max_prompt_len=512,
+        max_new_tokens=budget, decode_chunk=8,
+    )
+    prev_targets = set_slo_targets(SLOTargets(
+        ttft_p95_s=ttft_target_s, e2e_p99_s=60.0, fast_window_s=2.0,
+    ))
+    out = {
+        "trace_events": len(events),
+        "interactive_events": sum(e.qos == "interactive" for e in events),
+        "trace_span_s": round(events[-1].t, 2) if events else 0.0,
+        "compression": compression,
+        "ttft_target_s": ttft_target_s,
+        "capacity": {},
+    }
+    tokens_by_n = {}
+    try:
+        for n in (1, 2, 3):
+            fleet = ReplicaSet(
+                engine, scfg, settings=greedy(budget),
+                fleet=FleetConfig(replicas=n, fence_cooldown_s=0.1),
+                resilience=ResilienceConfig(enabled=True,
+                                            breaker_cooldown_s=0.05),
+                integrity=IntegrityConfig(canary_max_tokens=8),
+                overload=OverloadConfig(
+                    enabled=True, deadline_admission=False,
+                    aging_s=5.0 / compression, healthy_window_s=0.5,
+                    queue_window_s=1.0, eval_interval_s=0.1,
+                    burn_threshold=8.0, retry_after_s=0.2,
+                ),
+            )
+            # Warmup compiles the per-replica programs, then best-of-3.
+            # The zero-loss invariant must hold on EVERY run — a discarded
+            # slower run (or the warmup) losing requests is still a bug.
+            runs = [ReplayDriver(fleet, events, compression=compression,
+                                 max_wall_s=300.0).run()
+                    for _ in range(4)]
+            for k, r in enumerate(runs):
+                assert r.lost == 0, \
+                    f"replay lost requests at n={n} (run {k})"
+            report = min(runs[1:], key=lambda r: r.wall_s)
+            completed = report.outcomes.get("completed", 0)
+            attain = report.slo_attainment(ttft_target_s)
+            out["capacity"][str(n)] = {
+                "replicas": n,
+                "wall_s": round(report.wall_s, 3),
+                "profiles_per_sec": round(completed / report.wall_s, 2),
+                "profiles_per_sec_per_chip": round(
+                    completed / report.wall_s / n, 2),
+                "completed": completed,
+                "shed_rate": round(report.shed_rate(), 4),
+                "slo_attainment_ttft": (round(attain, 4)
+                                        if attain is not None else None),
+            }
+            tokens_by_n[n] = dict(report.tokens)
+    finally:
+        set_slo_targets(prev_targets)
+    # Fleet size must never change a completed request's tokens.
+    common = set(tokens_by_n[1]) & set(tokens_by_n[2]) & set(tokens_by_n[3])
+    assert common, "no common completed requests across fleet sizes"
+    for rid in common:
+        assert tokens_by_n[1][rid] == tokens_by_n[2][rid] == \
+            tokens_by_n[3][rid], f"fleet size changed tokens for {rid}"
+    out["parity_checked_requests"] = len(common)
+    return out
+
+
 def build_sweep_prompts():
     from fairness_llm_tpu.config import default_config
     from fairness_llm_tpu.data import (
@@ -1493,6 +1607,16 @@ def _run() -> None:
         print(f"prefix cache A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Capacity planning (ISSUE 11): one seeded synthetic trace replayed
+    # against 1/2/3-replica fleets — profiles/sec/chip vs interactive SLO
+    # attainment vs shed rate, token parity across sizes asserted.
+    capacity = None
+    try:
+        capacity = measure_capacity(engine, prompts, ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"capacity sweep skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Large-sweep throughput: decode is weight-streaming-bound at small batch,
     # so a thousands-of-profiles ML-1M sweep runs at the batch-192 rate
     # instead. Big models can OOM at this batch on one chip — report null
@@ -1830,6 +1954,7 @@ def _run() -> None:
             "overload_overhead": overload,
             "fairness_overhead": fairness,
             "prefix_cache": prefix_cache,
+            "capacity": capacity,
             "large_sweep": large_sweep,
             "large_sweep_int8kv": large_sweep_int8,
             "large_sweep_int8w_int8kv": large_sweep_int8w,

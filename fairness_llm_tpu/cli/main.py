@@ -196,6 +196,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "probes additionally defer until the replica's "
                         "open breakers can half-open, so the effective "
                         "delay is max of this and --breaker-cooldown)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --continuous: SLO-coupled elastic fleet "
+                        "(serving/autoscaler.py) — replica membership "
+                        "becomes a runtime control loop reading the "
+                        "fast-window SLO burn gauges, the overload rung, "
+                        "and queue depth; scale-up adds a canary-gated "
+                        "standby replica, scale-down retires the lowest-"
+                        "load replica through the drain/migration path "
+                        "(in-flight requests survive with token parity). "
+                        "Implies fleet mode even at --replicas 1. See "
+                        "docs/SERVING.md §Elastic fleet & autoscaling")
+    p.add_argument("--min-replicas", type=int, default=None, metavar="N",
+                   help="with --autoscale: lower membership bound "
+                        "(default 1)")
+    p.add_argument("--max-replicas", type=int, default=None, metavar="N",
+                   help="with --autoscale: upper membership bound "
+                        "(default 4)")
     p.add_argument("--max-step-seconds", type=float, default=None,
                    help="resilience watchdog: a compiled prefill/decode step "
                         "slower than this is classified HUNG and contained "
@@ -435,6 +452,33 @@ def config_from_args(args: argparse.Namespace) -> Config:
                 raise SystemExit("--fence-cooldown must be >= 0")
             fleet_kwargs["fence_cooldown_s"] = args.fence_cooldown
         updates["fleet"] = FleetConfig(**fleet_kwargs)
+    autoscale_flags = (args.min_replicas, args.max_replicas)
+    if args.autoscale or any(v is not None for v in autoscale_flags):
+        from fairness_llm_tpu.config import AutoscaleConfig
+
+        if not args.autoscale:
+            raise SystemExit("--min-replicas/--max-replicas require "
+                             "--autoscale")
+        if not args.continuous:
+            raise SystemExit("--autoscale requires --continuous (the "
+                             "autoscaler drives fleet membership over "
+                             "serving schedulers)")
+        as_kwargs: Dict = {"enabled": True}
+        if args.min_replicas is not None:
+            if args.min_replicas < 1:
+                raise SystemExit("--min-replicas must be >= 1")
+            as_kwargs["min_replicas"] = args.min_replicas
+        if args.max_replicas is not None:
+            if args.max_replicas < (args.min_replicas or 1):
+                raise SystemExit("--max-replicas must be >= --min-replicas")
+            as_kwargs["max_replicas"] = args.max_replicas
+        elif args.min_replicas is not None and \
+                args.min_replicas > AutoscaleConfig.max_replicas:
+            raise SystemExit(
+                f"--min-replicas {args.min_replicas} exceeds the default "
+                f"--max-replicas ({AutoscaleConfig.max_replicas}); pass "
+                "--max-replicas explicitly")
+        updates["autoscale"] = AutoscaleConfig(**as_kwargs)
     resilience_flags = (args.max_step_seconds, args.breaker_threshold,
                         args.breaker_cooldown, args.serving_journal,
                         args.drain_grace)

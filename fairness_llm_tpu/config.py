@@ -202,6 +202,58 @@ class FleetConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """SLO-coupled elastic-fleet knobs (``serving/autoscaler.py``).
+
+    ``enabled`` puts an :class:`Autoscaler` on the ``ReplicaSet``'s tick:
+    replica membership becomes a RUNTIME control loop instead of a fixed
+    ``--replicas N`` startup choice. The controller reads the signals the
+    stack already exports — per-replica fast-window ``slo_burn_rate``
+    gauges (telemetry/slo.py), the fleet ``overload_level`` rung
+    (serving/overload.py), and fleet-held queue depth — and drives
+    membership through the fence machinery:
+
+    - **scale-up**: a hot signal sustained for ``up_window_s`` (and past
+      ``cooldown_s`` since the last action) instantiates a STANDBY replica
+      — its own scheduler/SlotPool/BreakerBoard over the same engine
+      params — which is canary-gated through the fleet's rejoin probe
+      before it takes any traffic (a replica that cannot decode the golden
+      prompt never joins);
+    - **scale-down**: every signal cold for ``down_window_s`` retires the
+      lowest-load replica through the zero-grace
+      ``request_drain``/journal-migration path, so its in-flight requests
+      migrate to the survivors with original ids/settings/row_seeds
+      (token-for-token parity — the same contract a fence keeps).
+
+    Hysteresis: at most one membership change per ``cooldown_s``, each
+    direction requiring its own sustained window — a flapping signal can
+    never oscillate the fleet. ``min_replicas``/``max_replicas`` bound the
+    fleet absolutely. See docs/SERVING.md §Elastic fleet & autoscaling.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Scale-up signals: fast-window burn rate (error_rate or ttft_p95, the
+    # hottest replica) at/over up_burn_threshold, fleet-held queue depth
+    # at/over up_queue_frac of capacity, or the brownout ladder at/past
+    # up_overload_level (0 disables that signal).
+    up_burn_threshold: float = 2.0
+    up_queue_frac: float = 0.8
+    up_overload_level: int = 1
+    up_window_s: float = 1.0  # sustained hot before a scale-up
+    # Scale-down: burn under down_burn_threshold AND queue under
+    # down_queue_frac AND per-replica slot load under down_load_frac,
+    # sustained for down_window_s.
+    down_burn_threshold: float = 0.5
+    down_queue_frac: float = 0.1
+    down_load_frac: float = 0.5
+    down_window_s: float = 5.0
+    cooldown_s: float = 2.0  # min seconds between membership changes
+    eval_interval_s: float = 0.25  # min seconds between controller steps
+
+
+@dataclasses.dataclass(frozen=True)
 class ResilienceConfig:
     """Watchdog / circuit-breaker / graceful-drain knobs (``resilience/``).
 
@@ -420,6 +472,13 @@ class Config:
     # and drained, its requests migrate to healthy replicas, and it
     # rejoins through a canary probe. See docs/SERVING.md §Replica fleet.
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    # Elastic fleet: SLO-coupled autoscaling of replica membership
+    # (--autoscale; needs --continuous). Scale-up adds a canary-gated
+    # standby replica; scale-down retires the lowest-load replica through
+    # the drain/migration path. See docs/SERVING.md §Elastic fleet.
+    autoscale: AutoscaleConfig = dataclasses.field(
+        default_factory=AutoscaleConfig
+    )
     # Resilience: step watchdog + per-stage circuit breakers + graceful
     # drain/journal (off by default; --max-step-seconds/--serving-journal
     # and friends flip it on). See docs/RESILIENCE.md.

@@ -388,7 +388,8 @@ def backend_for(
                               resilience=resilience, journal=journal,
                               integrity=integrity,
                               fleet=getattr(config, "fleet", None),
-                              overload=getattr(config, "overload", None))
+                              overload=getattr(config, "overload", None),
+                              autoscale=getattr(config, "autoscale", None))
     # Speculation rides on the backend (not the engine default) so sweeps
     # opted in via Config get it while direct engine users stay explicit.
     spec = getattr(config, "speculation", None)

@@ -8,12 +8,26 @@ admission) -> ``paged`` (block arena + radix-tree prefix index, the
 block-table owner in paged mode)
 -> ``scheduler`` (the prefill/decode step loop) -> ``router``/``fleet``
 (health-aware routing over N replica schedulers, per-replica fault domains
-with fence/migrate/rejoin) -> ``backend`` (the ``DecodeBackend`` adapter
-the pipeline phases consume). See docs/SERVING.md.
+with fence/migrate/rejoin) -> ``autoscaler`` (SLO-coupled elastic
+membership over the fleet) -> ``backend`` (the ``DecodeBackend`` adapter
+the pipeline phases consume). ``replay`` sits beside the stack: a seeded
+synthetic-trace generator + replay driver that exercises all of it with
+production-shaped load. See docs/SERVING.md.
 """
 
+from fairness_llm_tpu.serving.autoscaler import Autoscaler
 from fairness_llm_tpu.serving.backend import ServingBackend
 from fairness_llm_tpu.serving.fleet import Replica, ReplicaSet
+from fairness_llm_tpu.serving.replay import (
+    ReplayClock,
+    ReplayDriver,
+    ReplayReport,
+    TraceConfig,
+    TraceEvent,
+    generate_trace,
+    read_trace,
+    write_trace,
+)
 from fairness_llm_tpu.serving.overload import (
     DeadlineEstimator,
     ShedController,
@@ -32,7 +46,16 @@ from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 
 __all__ = [
     "AdmissionQueue",
+    "Autoscaler",
     "BlockArena",
+    "ReplayClock",
+    "ReplayDriver",
+    "ReplayReport",
+    "TraceConfig",
+    "TraceEvent",
+    "generate_trace",
+    "read_trace",
+    "write_trace",
     "ClassedAdmissionQueue",
     "ContinuousScheduler",
     "DeadlineEstimator",

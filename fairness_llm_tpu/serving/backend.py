@@ -45,6 +45,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from fairness_llm_tpu.config import (
+    AutoscaleConfig,
     FleetConfig,
     IntegrityConfig,
     ModelSettings,
@@ -74,7 +75,8 @@ class ServingBackend:
                  journal: Optional[ServingJournal] = None,
                  integrity: Optional[IntegrityConfig] = None,
                  fleet: Optional[FleetConfig] = None,
-                 overload: Optional[OverloadConfig] = None):
+                 overload: Optional[OverloadConfig] = None,
+                 autoscale: Optional[AutoscaleConfig] = None):
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
         self.name = name or engine.config.name
@@ -89,12 +91,22 @@ class ServingBackend:
         # class a brownout sheds first so interactive traffic survives.
         self.overload = overload if (overload is not None
                                      and overload.enabled) else None
+        # Elastic membership (serving/autoscaler.py): --autoscale puts the
+        # SLO-coupled controller on each fleet's tick. It implies fleet
+        # mode even at --replicas 1 — a one-replica FLEET can grow; a bare
+        # scheduler cannot.
+        self.autoscale = autoscale if (autoscale is not None
+                                       and autoscale.enabled) else None
         # Replica fleet (serving/fleet.py): fleet.replicas > 1 makes
         # scheduler_for build a ReplicaSet per sampler tuple instead of a
         # single scheduler — N fault domains behind the health-aware
         # router, sharing this backend's engine params.
-        self.fleet = fleet if (fleet is not None and fleet.replicas > 1) \
-            else None
+        if fleet is not None and fleet.replicas > 1:
+            self.fleet = fleet
+        elif self.autoscale is not None:
+            self.fleet = fleet or FleetConfig(replicas=1)
+        else:
+            self.fleet = None
         self._fleet_seq = 0  # ReplicaSets built by this backend, ever
         # Canary probe (integrity/canary.py): built lazily on the first
         # generate() — recording its reference costs one static-engine
@@ -158,6 +170,7 @@ class ServingBackend:
                 integrity=self.integrity,
                 name=None if self._fleet_seq == 0 else f"s{self._fleet_seq}",
                 overload=self.overload,
+                autoscale=self.autoscale,
             )
             self._fleet_seq += 1
         else:

@@ -48,12 +48,24 @@ drains, not the fleet*:
   configured grace and preempts the fleet-held tail, exactly like the
   single-scheduler contract.
 
+- **Elastic membership** (``serving/autoscaler.py``, ``autoscale=``):
+  replica count is a RUNTIME control loop, not a startup constant —
+  ``add_replica`` instantiates a standby (canary-gated through the rejoin
+  probe before it takes traffic) and ``retire_replica`` removes the
+  lowest-load replica through the same zero-grace drain + migration path
+  a fence uses, so in-flight work survives a scale-down with token
+  parity. The ``submit``/``tick``/``take_result`` streaming surface lets
+  external drivers (the trace replay, ``serving/replay.py``) feed the
+  fleet without a blocking ``serve``.
+
 Fleet telemetry: ``fleet_replicas`` / ``fleet_healthy_replicas`` gauges,
 ``fleet_fenced_total{replica,reason}`` / ``fleet_rejoins_total{replica}`` /
 ``fleet_migrated_requests_total`` / ``fleet_migrated_recovered_total``
 counters, and ``fleet_failover_recovery_s`` (fence -> first migrated
 token) — ``tools/validate_telemetry.py --require-fleet`` gates a drill on
-them.
+them; ``--require-autoscale`` gates the elastic cycle
+(``fleet_retired_total`` / ``fleet_standby_denied_total`` /
+``autoscale_events_total``).
 """
 
 from __future__ import annotations
@@ -65,6 +77,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
 from fairness_llm_tpu.config import (
+    AutoscaleConfig,
     FleetConfig,
     IntegrityConfig,
     ModelSettings,
@@ -164,6 +177,7 @@ class ReplicaSet:
         integrity: Optional[IntegrityConfig] = None,
         name: Optional[str] = None,
         overload: Optional[OverloadConfig] = None,
+        autoscale: Optional[AutoscaleConfig] = None,
     ):
         # ``name`` namespaces this fleet's instruments when a process runs
         # MORE THAN ONE ReplicaSet (ServingBackend keeps one per sampler
@@ -199,18 +213,32 @@ class ReplicaSet:
             per_replica = [engines] * self.fleet.replicas
         # Replica schedulers: rate limiting stays at the FLEET queue (one
         # quota for the fleet, not N), everything else per-replica.
-        rep_serving = dataclasses.replace(
+        self._rep_serving = dataclasses.replace(
             self.serving, admission_per_minute=None
         )
+        # The engine pool a SCALE-UP draws from (serving/autoscaler.py):
+        # shared-params fleets reuse the one engine; per-replica-engine
+        # fleets round-robin the original pool (a standby replica shares
+        # params with a retired sibling's engine — the CPU-harness shape;
+        # real multi-chip elasticity would plug fresh engines in here).
+        self._engine_pool = per_replica
+        # Monotone replica naming: retired names are never reused, so a
+        # fleet that scaled 1 -> 2 -> 1 -> 2 reads r0/r1/r2 in telemetry
+        # instead of two different lifetimes aliasing one "r1" label.
+        self._replica_seq = self.fleet.replicas
         self.replicas: List[Replica] = []
         for i, eng in enumerate(per_replica):
             rep_name = f"{name}.r{i}" if name else f"r{i}"
             sched = ContinuousScheduler(
-                eng, rep_serving, settings=self.settings,
+                eng, self._rep_serving, settings=self.settings,
                 fault_injector=fault_injector, resilience=resilience,
                 journal=journal, replica=rep_name,
             )
             self.replicas.append(Replica(rep_name, eng, sched))
+        # Stats of replicas retired mid-run (scale-down): folded into the
+        # next _finish_stats so their completed/shed/token counts are not
+        # lost from the fleet record with the replica.
+        self._retired_stats: List[ServingStats] = []
         # Overload control (serving/overload.py): the fleet intake is the
         # front door in fleet mode, so the gate lives HERE — replica
         # schedulers stay plain (gating again after routing would
@@ -265,6 +293,19 @@ class ReplicaSet:
                   **self._fleet_labels).set(len(self.replicas))
         reg.gauge("fleet_healthy_replicas", component="fleet",
                   **self._fleet_labels).set(len(self.replicas))
+        # Elastic membership (serving/autoscaler.py): with autoscale armed,
+        # the fleet's tick runs an SLO-coupled controller that adds
+        # canary-gated standby replicas under sustained burn/queue pressure
+        # and retires the lowest-load replica through the drain/migration
+        # path when the fleet is sustainedly cold.
+        if autoscale is not None and autoscale.enabled:
+            from fairness_llm_tpu.serving.autoscaler import Autoscaler
+
+            self.autoscaler: Optional[Autoscaler] = Autoscaler(
+                self, autoscale
+            )
+        else:
+            self.autoscaler = None
 
     # -- ContinuousScheduler-surface compatibility ---------------------------
 
@@ -440,6 +481,76 @@ class ReplicaSet:
             time.sleep(poll_s)
         return True
 
+    # -- streaming surface (submit/tick/take_result) -------------------------
+    #
+    # The serve() path above is the batch surface the phases consume; the
+    # trio below is the STREAMING surface external drivers use — the load
+    # replay (serving/replay.py) submits trace events as their arrival
+    # times come due and ticks the fleet between arrivals, mirroring the
+    # ContinuousScheduler's own submit()/step()/take_result() hooks.
+
+    def submit(self, request: Request, restamp: bool = True,
+               count_rejection: bool = True) -> bool:
+        """Queue one request at the fleet intake; False = backpressure
+        (fleet queue full / class bound / rate quota — nothing enqueued,
+        the caller may retry) OR a terminal overload shed — the two read
+        apart via ``take_result``: a shed leaves a claimable
+        ``finish_reason="shed"`` Result with a retry-after hint,
+        backpressure leaves nothing. Accepted requests are journaled at
+        intake (the zero-accepted-then-lost ledger) and routed to a
+        replica on a later ``tick``. ``count_rejection=False`` marks a
+        RE-offer of an arrival whose first refusal was already counted
+        (the replay driver's retry loop): capacity and quota still apply,
+        but the stats don't count a fresh rejection per poll."""
+        self.replicas[0].sched._check_settings(request)
+        if restamp:
+            request.submitted_at = time.monotonic()
+        if self._overload_gate(request, journaled=False):
+            return False
+        accepted = self.queue.submit(request, count_rejection=count_rejection)
+        if accepted and self.journal is not None:
+            self.journal.record_submitted(request)
+        return accepted
+
+    def tick(self) -> bool:
+        """ONE fleet loop iteration — route, step every replica, fence /
+        rejoin / autoscale as due. Honors a process-wide drain request
+        exactly as ``serve`` does. Returns True when any work moved."""
+        if drain_requested():
+            self._drain_all()
+            return False
+        return self._tick()
+
+    def take_result(self, request_id: str) -> Optional[Result]:
+        """Claim (and remove) the Result of a request submitted via
+        ``submit()`` that has since terminated — the retrieval half of the
+        streaming surface."""
+        res = self._results.pop(request_id, None)
+        if res is not None:
+            self._migrated_ids.discard(request_id)
+            self._recovered_ids.discard(request_id)
+        return res
+
+    @property
+    def has_work(self) -> bool:
+        """Anything still owed a Result: fleet-held (pending, queued,
+        awaiting migration) or live on a replica."""
+        return bool(self._pending or len(self.queue) or self._migrating
+                    or any(r.sched.has_work for r in self.replicas))
+
+    def drain(self) -> ServingStats:
+        """Run the fleet loop until nothing is owed, then close out the
+        stats window — the streaming companion to ``serve()``. Terminated
+        requests' Results wait in ``take_result``."""
+        while self.has_work:
+            if drain_requested():
+                self._drain_all()
+                break
+            if not self._tick():
+                time.sleep(0.002)
+        self._finish_stats()
+        return self.last_stats
+
     # -- the fleet loop ------------------------------------------------------
 
     def _tick(self) -> bool:
@@ -452,9 +563,18 @@ class ReplicaSet:
                 self.serving.queue_capacity,
             )
             self.shed_controller.maybe_evaluate()
-        progressed = self._expire_held()
+        progressed = False
+        if self.autoscaler is not None:
+            # Membership control BEFORE routing: a replica added this tick
+            # takes traffic this tick, and a retired one has already
+            # migrated its work into _migrating for _route to place.
+            progressed |= self.autoscaler.maybe_tick()
+        progressed |= self._expire_held()
         progressed |= self._route()
-        for rep in self.replicas:
+        # list(): the autoscaler (above) is not the only mutation source —
+        # a fence-triggered retire queued by a future controller must
+        # never invalidate this iteration mid-walk.
+        for rep in list(self.replicas):
             if rep.fenced:
                 progressed |= self._maybe_rejoin(rep)
                 continue
@@ -669,9 +789,20 @@ class ReplicaSet:
             "migrating", rep.name, reason, rep.sched.pool.occupancy,
             len(rep.sched.queue),
         )
-        # Drain through the journal path with ZERO grace: a replica judged
-        # sick must not keep decoding work that should migrate — and for a
-        # crash there is no replica left to grant grace to.
+        migrated = self._evacuate(rep, reason)
+        emit_event("replica_fence_complete", replica=rep.name,
+                   reason=reason, migrated=migrated)
+
+    def _evacuate(self, rep: Replica, reason: str,
+                  count_failover: bool = True) -> int:
+        """Drain ``rep`` with ZERO grace through the journal path and
+        migrate everything unfinished — the shared mechanics of a FENCE (a
+        replica judged sick must not keep decoding work that should
+        migrate) and a RETIREMENT (a scale-down's victim hands its
+        in-flight work to the survivors). Returns the migrated count.
+        ``count_failover=False`` (retirement) keeps the planned evacuation
+        out of the fleet_failover_recovery_s clock — failover time
+        measures incidents, not scaling decisions."""
         rep.sched.request_drain(grace_s=0.0)
         rep.sched.step(rep.stats)
         if reason in CRASH_CLASS_REASONS and rep.sched.breakers is not None:
@@ -713,14 +844,102 @@ class ReplicaSet:
                 self._migrated_ids.add(rid)
                 newly_migrated += 1
         if newly_migrated:
-            reg.counter("fleet_migrated_requests_total", component="fleet",
-                        **self._fleet_labels).inc(newly_migrated)
+            get_registry().counter(
+                "fleet_migrated_requests_total", component="fleet",
+                **self._fleet_labels,
+            ).inc(newly_migrated)
         if migrated:
-            self._failover_pending = True
+            if count_failover:
+                self._failover_pending = True
             get_timeline().record_instant("migrate", rep.name,
                                           migrated=migrated)
-        emit_event("replica_fence_complete", replica=rep.name,
-                   reason=reason, migrated=migrated)
+        return migrated
+
+    # -- elastic membership (serving/autoscaler.py) --------------------------
+
+    def add_replica(self) -> Optional[Replica]:
+        """Instantiate a STANDBY replica — its own scheduler, slot pool,
+        breakers, and watchdog over the engine pool's params — and
+        canary-gate it through the fleet's rejoin probe BEFORE it joins:
+        a standby that cannot decode the golden prompt (or complete a
+        smoke decode, for sampled fleets) never takes traffic. Returns the
+        joined Replica, or None when the probe refused it (counted in
+        ``fleet_standby_denied_total``; the autoscaler retries after its
+        cooldown). Names are monotone (``r<seq>``) so a scaled-away
+        replica's telemetry is never aliased by a later arrival."""
+        i = self._replica_seq
+        self._replica_seq += 1
+        rep_name = f"{self.name}.r{i}" if self.name else f"r{i}"
+        engine = self._engine_pool[i % len(self._engine_pool)]
+        sched = ContinuousScheduler(
+            engine, self._rep_serving, settings=self.settings,
+            fault_injector=self.fault_injector, resilience=self.resilience,
+            journal=self.journal, replica=rep_name,
+        )
+        rep = Replica(rep_name, engine, sched)
+        if not self._rejoin_probe(rep):
+            get_registry().counter(
+                "fleet_standby_denied_total", component="fleet",
+                replica=rep_name,
+            ).inc()
+            emit_event("replica_standby_denied", replica=rep_name)
+            logger.warning("standby replica %s failed its canary gate; "
+                           "not joining the fleet", rep_name)
+            return None
+        self.replicas.append(rep)
+        reg = get_registry()
+        reg.counter("fleet_scale_ups_total", component="fleet",
+                    **self._fleet_labels).inc()
+        reg.gauge("fleet_replicas", component="fleet",
+                  **self._fleet_labels).set(len(self.replicas))
+        self._update_health_gauge()
+        emit_event("replica_added", replica=rep_name,
+                   replicas=len(self.replicas))
+        get_timeline().record_instant("scale_up", rep_name)
+        logger.warning("replica %s passed its standby canary; joined the "
+                       "fleet (%d replicas)", rep_name, len(self.replicas))
+        return rep
+
+    def retire_replica(self, rep: Replica) -> int:
+        """Remove ``rep`` from the fleet through the zero-grace
+        drain + journal-migration path: its in-flight requests migrate to
+        the survivors with original ids/settings/row_seeds (token-for-token
+        parity — the fence's contract) and its stats fold into the fleet
+        record. Distinct from a fence: retirement is a PLANNED exit (no
+        fence counter, no failover clock, no rejoin — the replica is
+        gone). Returns the migrated count."""
+        if len(self.replicas) <= 1:
+            raise ValueError("cannot retire the last replica")
+        if rep not in self.replicas:
+            raise ValueError(f"replica {rep.name!r} is not in this fleet")
+        # Fenced-flagged during evacuation so the router never places new
+        # work on a replica mid-retirement.
+        rep.fenced = True
+        rep.fence_reason = "retired"
+        reg = get_registry()
+        reg.counter("fleet_retired_total", component="fleet",
+                    replica=rep.name).inc()
+        emit_event("replica_retiring", replica=rep.name,
+                   live=rep.sched.pool.occupancy,
+                   queued=len(rep.sched.queue))
+        get_timeline().record_instant("scale_down", rep.name)
+        logger.warning(
+            "retiring replica %s: %d live, %d queued — draining and "
+            "migrating to survivors", rep.name, rep.sched.pool.occupancy,
+            len(rep.sched.queue),
+        )
+        migrated = self._evacuate(rep, "retired", count_failover=False)
+        # Fold the retired replica's stats into the next stats close so
+        # its completed/shed/token counts survive the membership change.
+        rep.sched.finish_stats(rep.stats)
+        self._retired_stats.append(rep.stats)
+        self.replicas.remove(rep)
+        reg.gauge("fleet_replicas", component="fleet",
+                  **self._fleet_labels).set(len(self.replicas))
+        self._update_health_gauge()
+        emit_event("replica_retired", replica=rep.name, migrated=migrated,
+                   replicas=len(self.replicas))
+        return migrated
 
     def _maybe_rejoin(self, rep: Replica) -> bool:
         """Probe a fenced replica once its cooldown elapses; rejoin on a
@@ -811,8 +1030,15 @@ class ReplicaSet:
             from fairness_llm_tpu.integrity.canary import CanaryProbe
 
             if self._canary_ref is None:
+                # Clamped to the serving decode cap: the probe decodes
+                # through the replica's scheduler, which clamps every
+                # request to max_new_tokens — a reference recorded longer
+                # than the scheduler can decode would fail the
+                # pads-beyond-prefix check on a perfectly healthy replica.
                 self._canary_ref = CanaryProbe.record(
-                    rep.engine, max_tokens=self.integrity.canary_max_tokens,
+                    rep.engine,
+                    max_tokens=min(self.integrity.canary_max_tokens,
+                                   self.serving.max_new_tokens),
                 )
             rep.canary = self._canary_ref.for_replica(
                 rep.name, board=rep.sched.breakers
@@ -887,6 +1113,12 @@ class ReplicaSet:
 
     def _finish_stats(self) -> None:
         agg = ServingStats(num_slots=0)
+        # Replicas retired mid-window first: their schedulers already
+        # closed out at retirement, but the work they did belongs to this
+        # window's fleet record.
+        for st in self._retired_stats:
+            agg = agg.merge(st)
+        self._retired_stats = []
         for rep in self.replicas:
             rep.sched.finish_stats(rep.stats)
             agg = agg.merge(rep.stats)

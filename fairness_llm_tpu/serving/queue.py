@@ -154,6 +154,11 @@ class ClassedAdmissionQueue(AdmissionQueue):
     ):
         super().__init__(capacity=capacity, rate_limiter=rate_limiter)
         self.overload = overload or OverloadConfig(enabled=True)
+        # ``clock`` drives BOTH aging promotion (pop) and the default
+        # expiry sweep below, and threads into the per-class quota ledgers
+        # — so a fake clock can age a simulated-hours flood in
+        # microseconds (tests/test_replay.py soak tests) and a compressed
+        # replay ages in trace time. Default time.monotonic: unchanged.
         self._clock = clock
         self._classes: Dict[str, Deque[Request]] = {
             c: deque() for c in QOS_CLASSES
@@ -165,11 +170,11 @@ class ClassedAdmissionQueue(AdmissionQueue):
             "probe": o.probe_capacity,
         }
         self._class_limiters: Dict[str, Optional[RateLimiter]] = {
-            "interactive": RateLimiter(o.interactive_per_minute)
+            "interactive": RateLimiter(o.interactive_per_minute, clock=clock)
             if o.interactive_per_minute else None,
-            "batch": RateLimiter(o.batch_per_minute)
+            "batch": RateLimiter(o.batch_per_minute, clock=clock)
             if o.batch_per_minute else None,
-            "probe": RateLimiter(o.probe_per_minute)
+            "probe": RateLimiter(o.probe_per_minute, clock=clock)
             if o.probe_per_minute else None,
         }
 
@@ -253,6 +258,12 @@ class ClassedAdmissionQueue(AdmissionQueue):
         return out
 
     def drain_expired(self, now: Optional[float] = None) -> List[Request]:
+        # Default ``now`` from the injected clock (the base queue lets
+        # Request.expired read wall time): expiry must age on the same
+        # clock as the aging promotion, or a fake-clock soak test would
+        # promote requests the wall clock says are still fresh.
+        if now is None:
+            now = self._clock()
         expired: List[Request] = []
         for c, q in self._classes.items():
             keep: Deque[Request] = deque()

@@ -13,6 +13,7 @@ visible in the results.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 # Module scope, not the fault hot path: these imports used to run inside
@@ -91,6 +92,18 @@ class ScriptedFaultInjector:
     exists to catch. Both are counted with their own ``kind`` labels
     (``injected_replica_crash`` / ``injected_replica_hang``) so a fleet
     drill's telemetry reads apart from single-engine chaos.
+
+    TIME-INDEXED schedule (the load-replay sibling of the count-based
+    budgets above): ``faults_at`` / ``hangs_at`` / ``corruptions_at``
+    (same key scheme, values in SECONDS) and ``replica_crashes_at`` /
+    ``replica_hangs_at`` (replica name -> seconds) fire ONCE the first
+    time the corresponding ``maybe_*`` hook runs at or after that many
+    seconds on the injector's clock. The clock starts at the first hook
+    call — or at ``arm()``, which the replay driver
+    (``serving/replay.py``) calls with its own trace clock, so a replica
+    crash pins to trace-time "middle of the burst" regardless of the
+    time-compression factor, instead of counting calls whose cadence the
+    workload shape changes.
     """
 
     def __init__(
@@ -102,6 +115,12 @@ class ScriptedFaultInjector:
         corruption_mode: str = "nan",
         replica_crashes: Optional[Dict[str, int]] = None,
         replica_hangs: Optional[Dict[str, int]] = None,
+        faults_at: Optional[Dict[object, float]] = None,
+        hangs_at: Optional[Dict[object, float]] = None,
+        corruptions_at: Optional[Dict[object, float]] = None,
+        replica_crashes_at: Optional[Dict[str, float]] = None,
+        replica_hangs_at: Optional[Dict[str, float]] = None,
+        clock: Optional[Callable[[], float]] = None,
     ):
         if corruption_mode not in ("nan", "inf"):
             raise ValueError(
@@ -119,6 +138,26 @@ class ScriptedFaultInjector:
                     f"replica {name!r} scripted for both crash and hang"
                 )
             self._replica_delay[name] = (int(delay), "replica_hang")
+        self._at = {
+            "fault": dict(faults_at or {}),
+            "hang": dict(hangs_at or {}),
+            "corruption": dict(corruptions_at or {}),
+        }
+        self._replica_at: Dict[str, tuple] = {}
+        for name, at in (replica_crashes_at or {}).items():
+            if name in self._replica_delay:
+                raise ValueError(
+                    f"replica {name!r} scripted for more than one fault"
+                )
+            self._replica_at[name] = (float(at), "replica_crash")
+        for name, at in (replica_hangs_at or {}).items():
+            if name in self._replica_at or name in self._replica_delay:
+                raise ValueError(
+                    f"replica {name!r} scripted for more than one fault"
+                )
+            self._replica_at[name] = (float(at), "replica_hang")
+        self._clock: Callable[[], float] = clock or time.monotonic
+        self._t0: Optional[float] = None
         self.corruption_mode = corruption_mode
         self.hang_seconds = float(hang_seconds)
         self.fired: List[tuple] = []  # (request_id, stage) audit log
@@ -126,54 +165,102 @@ class ScriptedFaultInjector:
         self.corruptions_fired: List[tuple] = []
         self.replica_faults_fired: List[tuple] = []  # (replica, kind)
 
-    def maybe_fail(self, request_id: str, stage: str) -> None:
+    # -- the time-indexed clock ----------------------------------------------
+
+    def arm(self, clock: Optional[Callable[[], float]] = None) -> None:
+        """Start (or restart) the schedule clock — ``at_seconds`` entries
+        are relative to this moment. ``clock`` replaces the injector's
+        clock for the rest of the run (the replay driver passes its trace
+        clock, so schedule times are TRACE seconds). Never called: the
+        clock self-arms at the first ``maybe_*`` hook, in wall seconds."""
+        if clock is not None:
+            self._clock = clock
+        self._t0 = self._clock()
+
+    def _elapsed(self) -> float:
+        if self._t0 is None:
+            self._t0 = self._clock()
+        return self._clock() - self._t0
+
+    def _due(self, kind: str, request_id: str, stage: str) -> bool:
+        """One consumed time-schedule hit for ``kind`` (fault/hang/
+        corruption), matching the count-based key scheme."""
+        sched = self._at[kind]
+        if not sched:
+            return False
+        elapsed = self._elapsed()
         for key in ((request_id, stage), request_id):
-            n = self._budget.get(key, 0)
-            if n > 0:
-                self._budget[key] = n - 1
-                self.fired.append((request_id, stage))
-                # Injected faults are labeled apart from device-raised ones
-                # (the scheduler counts those kind="device") so a chaos
-                # drill's telemetry can't be mistaken for a real incident.
-                get_registry().counter(
-                    "faults_total", component="serving", kind="injected",
-                    stage=stage,
-                ).inc()
-                raise DecodeFault(
-                    f"injected {stage} fault for request {request_id!r}"
-                )
+            at = sched.get(key)
+            if at is not None and elapsed >= at:
+                del sched[key]
+                return True
+        return False
+
+    def maybe_fail(self, request_id: str, stage: str) -> None:
+        due = self._due("fault", request_id, stage)
+        if not due:
+            for key in ((request_id, stage), request_id):
+                n = self._budget.get(key, 0)
+                if n > 0:
+                    self._budget[key] = n - 1
+                    due = True
+                    break
+        if due:
+            self.fired.append((request_id, stage))
+            # Injected faults are labeled apart from device-raised ones
+            # (the scheduler counts those kind="device") so a chaos
+            # drill's telemetry can't be mistaken for a real incident.
+            get_registry().counter(
+                "faults_total", component="serving", kind="injected",
+                stage=stage,
+            ).inc()
+            raise DecodeFault(
+                f"injected {stage} fault for request {request_id!r}"
+            )
 
     def maybe_hang(self, request_id: str, stage: str) -> float:
         """Simulated stall seconds this request contributes to the current
-        step (0.0 almost always). Consumes one hang budget per hit."""
-        for key in ((request_id, stage), request_id):
-            n = self._hang_budget.get(key, 0)
-            if n > 0:
-                self._hang_budget[key] = n - 1
-                self.hangs_fired.append((request_id, stage))
-                get_registry().counter(
-                    "faults_total", component="serving",
-                    kind="injected_hang", stage=stage,
-                ).inc()
-                return self.hang_seconds
+        step (0.0 almost always). Consumes one hang budget (or due
+        time-schedule entry) per hit."""
+        due = self._due("hang", request_id, stage)
+        if not due:
+            for key in ((request_id, stage), request_id):
+                n = self._hang_budget.get(key, 0)
+                if n > 0:
+                    self._hang_budget[key] = n - 1
+                    due = True
+                    break
+        if due:
+            self.hangs_fired.append((request_id, stage))
+            get_registry().counter(
+                "faults_total", component="serving",
+                kind="injected_hang", stage=stage,
+            ).inc()
+            return self.hang_seconds
         return 0.0
 
     def maybe_corrupt(self, request_id: str, stage: str) -> Optional[str]:
         """Corruption mode ("nan"/"inf") the scheduler should poison this
         request's carried logits with before the next compiled step — None
-        almost always. Consumes one corruption budget per hit. The poison
-        happens host-side on the carry (not inside the program), so the
-        guarded program itself stays the production one."""
-        for key in ((request_id, stage), request_id):
-            n = self._corruption_budget.get(key, 0)
-            if n > 0:
-                self._corruption_budget[key] = n - 1
-                self.corruptions_fired.append((request_id, stage))
-                get_registry().counter(
-                    "faults_total", component="serving",
-                    kind="injected_corruption", stage=stage,
-                ).inc()
-                return self.corruption_mode
+        almost always. Consumes one corruption budget (or due
+        time-schedule entry) per hit. The poison happens host-side on the
+        carry (not inside the program), so the guarded program itself
+        stays the production one."""
+        due = self._due("corruption", request_id, stage)
+        if not due:
+            for key in ((request_id, stage), request_id):
+                n = self._corruption_budget.get(key, 0)
+                if n > 0:
+                    self._corruption_budget[key] = n - 1
+                    due = True
+                    break
+        if due:
+            self.corruptions_fired.append((request_id, stage))
+            get_registry().counter(
+                "faults_total", component="serving",
+                kind="injected_corruption", stage=stage,
+            ).inc()
+            return self.corruption_mode
         return None
 
     def maybe_replica_fault(self, replica: str) -> Optional[str]:
@@ -181,15 +268,24 @@ class ScriptedFaultInjector:
         ``"replica_hang"``, or None (almost always). The scripted delay
         counts down one per poll; at zero the fault fires once and the
         script entry is consumed (a crashed replica doesn't crash twice —
-        it fences, migrates its work, and rejoins through the canary)."""
-        entry = self._replica_delay.get(replica)
-        if entry is None:
-            return None
-        delay, kind = entry
-        if delay > 0:
-            self._replica_delay[replica] = (delay - 1, kind)
-            return None
-        del self._replica_delay[replica]
+        it fences, migrates its work, and rejoins through the canary).
+        ``replica_crashes_at`` entries instead fire at their scheduled
+        second — whichever poll first observes the clock past it."""
+        kind = None
+        at_entry = self._replica_at.get(replica)
+        if at_entry is not None and self._elapsed() >= at_entry[0]:
+            del self._replica_at[replica]
+            kind = at_entry[1]
+        if kind is None:
+            entry = self._replica_delay.get(replica)
+            if entry is None:
+                return None
+            delay, k = entry
+            if delay > 0:
+                self._replica_delay[replica] = (delay - 1, k)
+                return None
+            del self._replica_delay[replica]
+            kind = k
         self.replica_faults_fired.append((replica, kind))
         get_registry().counter(
             "faults_total", component="fleet", kind=f"injected_{kind}",

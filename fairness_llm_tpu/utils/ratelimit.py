@@ -13,28 +13,40 @@ Two acquisition styles over one trailing-window ledger:
 
 Semantics match the reference: at most ``calls_per_minute`` calls in any
 trailing ``window_seconds`` window.
+
+``clock`` is injectable (default ``time.monotonic``, behavior unchanged):
+the load-replay soak tests (``serving/replay.py``, tests/test_replay.py)
+age quota windows across simulated hours without sleeping, and a
+time-compressed replay can run the limiter on its own compressed clock.
+The blocking ``wait_if_needed`` still sleeps real seconds — only the
+ledger's notion of "now" is injected.
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Deque
+from typing import Callable, Deque
 
 
 class RateLimiter:
-    def __init__(self, calls_per_minute: int = 60, window_seconds: float = 60.0):
+    def __init__(self, calls_per_minute: int = 60, window_seconds: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
         self.calls_per_minute = calls_per_minute
         self.window = window_seconds
+        self._clock = clock
         self._times: Deque[float] = deque()
+
+    def _prune(self, now: float) -> None:
+        while self._times and now - self._times[0] >= self.window:
+            self._times.popleft()
 
     def try_acquire(self) -> bool:
         """Non-blocking admit: True (and the call is recorded) when the
         trailing window has room, False (nothing recorded) when it doesn't.
         Never sleeps; ``wait_if_needed`` semantics are unchanged."""
-        now = time.monotonic()
-        while self._times and now - self._times[0] >= self.window:
-            self._times.popleft()
+        now = self._clock()
+        self._prune(now)
         if len(self._times) >= self.calls_per_minute:
             return False
         self._times.append(now)
@@ -46,24 +58,19 @@ class RateLimiter:
         admission queue checks a per-class quota AND the shared one) —
         consuming one limiter's token and then failing the other would
         burn quota on a submission that was never admitted."""
-        now = time.monotonic()
-        while self._times and now - self._times[0] >= self.window:
-            self._times.popleft()
+        self._prune(self._clock())
         return len(self._times) < self.calls_per_minute
 
     def wait_if_needed(self) -> float:
         """Block until a call is allowed; returns seconds slept."""
-        now = time.monotonic()
-        while self._times and now - self._times[0] >= self.window:
-            self._times.popleft()
+        now = self._clock()
+        self._prune(now)
         slept = 0.0
         if len(self._times) >= self.calls_per_minute:
             wait = self.window - (now - self._times[0])
             if wait > 0:
                 time.sleep(wait)
                 slept = wait
-            now = time.monotonic()
-            while self._times and now - self._times[0] >= self.window:
-                self._times.popleft()
-        self._times.append(time.monotonic())
+            self._prune(self._clock())
+        self._times.append(self._clock())
         return slept

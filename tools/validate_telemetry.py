@@ -43,6 +43,15 @@ histogram, and — when the serving canary ran — zero
 ``canary_mismatch_total`` (the canary decodes through the live paged
 scheduler against a static-engine reference, so it IS the token-parity
 witness for the paged path).
+``--require-autoscale`` requires the elastic-fleet signals the replay
+smoke drill produces (ISSUE 11): at least one
+``autoscale_events_total{direction="up"}`` AND one ``direction="down"``
+(a full elastic cycle), ``replay_accepted_total`` equal to
+``replay_terminal_total`` (zero accepted-then-lost across the replay),
+``fleet_migrated_requests_total`` equal to
+``fleet_migrated_recovered_total``, and every fleet's
+``fleet_healthy_replicas`` back to its ``fleet_replicas`` (the trace's
+crashed replica rejoined; retired replicas left the gauge entirely).
 ``--require-fairness`` requires the fairness-observability signals a
 fault-free ``--fairness-obs --continuous`` study produces (ISSUE 9):
 nonzero ``fairness_requests_total`` and ``fairness_pairs_joined_total``,
@@ -73,13 +82,16 @@ def check(path: str, require_serving: bool = False,
           require_profile: bool = False,
           require_overload: bool = False,
           require_fairness: bool = False,
-          require_prefix_cache: bool = False) -> int:
+          require_prefix_cache: bool = False,
+          require_autoscale: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
     if require_profile:
         problems.extend(_check_profile(path, snap))
     if require_fairness:
         problems.extend(_check_fairness(snap))
+    if require_autoscale:
+        problems.extend(_check_autoscale(snap))
     if require_prefix_cache:
         problems.extend(_check_prefix_cache(snap))
     if require_overload:
@@ -225,6 +237,76 @@ def check(path: str, require_serving: bool = False,
           f"({len(snap.get('counters', []))} counters, "
           f"{len(snap.get('histograms', []))} histograms)")
     return 0
+
+
+def _check_autoscale(snap: dict) -> list:
+    """The --require-autoscale gate (ISSUE 11): a full elastic cycle
+    (scale-up AND scale-down), zero accepted-then-lost across the replay,
+    migrated == recovered, and every fleet whole at the end."""
+    problems = []
+    counters = snap.get("counters", [])
+
+    def total(name, **want):
+        return sum(
+            c["value"] for c in counters
+            if c.get("name") == name and all(
+                c.get("labels", {}).get(k) == v for k, v in want.items()
+            )
+        )
+
+    ups = total("autoscale_events_total", direction="up")
+    downs = total("autoscale_events_total", direction="down")
+    if not ups:
+        problems.append("no autoscale_events_total{direction=up} (the "
+                        "burst never drove a scale-up)")
+    if not downs:
+        problems.append("no autoscale_events_total{direction=down} (the "
+                        "quiet tail never drove a scale-down)")
+    accepted = total("replay_accepted_total")
+    terminal = total("replay_terminal_total")
+    if not accepted:
+        problems.append("replay_accepted_total is zero (no replay ran)")
+    elif accepted != terminal:
+        problems.append(
+            f"replay accepted ({accepted:g}) != terminal ({terminal:g}) — "
+            "accepted requests were lost"
+        )
+    migrated = total("fleet_migrated_requests_total")
+    recovered = total("fleet_migrated_recovered_total")
+    if migrated != recovered:
+        problems.append(
+            f"migrated ({migrated:g}) != recovered ({recovered:g}) — "
+            "migrated requests were lost"
+        )
+    # Final fleet wholeness, per label set (same pairing rule as
+    # --require-fleet; a retired replica shrinks fleet_replicas, so a
+    # scaled-down fleet still reads whole here).
+    fleets = {}
+    for g in snap.get("gauges", []):
+        labels = g.get("labels", {})
+        if labels.get("component") != "fleet":
+            continue
+        key = tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "component"
+        ))
+        fleets.setdefault(key, {})[g["name"]] = g["value"]
+    saw_fleet = False
+    for key, vals in fleets.items():
+        if "fleet_replicas" not in vals:
+            continue
+        saw_fleet = True
+        replicas = vals["fleet_replicas"]
+        healthy = vals.get("fleet_healthy_replicas", -1)
+        if healthy != replicas:
+            tag = dict(key).get("fleet", "default")
+            problems.append(
+                f"fleet {tag!r}: fleet_healthy_replicas ({healthy:g}) != "
+                f"fleet_replicas ({replicas:g}) — the final fleet is not "
+                "healthy"
+            )
+    if not saw_fleet:
+        problems.append("no fleet_replicas gauge (no fleet was armed)")
+    return problems
 
 
 # |live - offline| bound for the streaming-vs-offline fairness cross-check:
@@ -410,6 +492,7 @@ def main() -> int:
     ap.add_argument("--require-overload", action="store_true")
     ap.add_argument("--require-fairness", action="store_true")
     ap.add_argument("--require-prefix-cache", action="store_true")
+    ap.add_argument("--require-autoscale", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
@@ -418,7 +501,8 @@ def main() -> int:
                  require_profile=a.require_profile,
                  require_overload=a.require_overload,
                  require_fairness=a.require_fairness,
-                 require_prefix_cache=a.require_prefix_cache)
+                 require_prefix_cache=a.require_prefix_cache,
+                 require_autoscale=a.require_autoscale)
 
 
 if __name__ == "__main__":
