@@ -37,6 +37,162 @@ REFERENCE_PROFILES_PER_SEC = 45 / (15 * 60)  # README estimate: 45 profiles / ~1
 MAX_NEW_TOKENS = 128
 V5E_HBM_GBPS = 819.0  # v5e spec HBM bandwidth — the decode roofline reference
 
+# -- entry selection (ISSUE 12) ------------------------------------------------
+# ``--entries a,b,c`` (or BENCH_ENTRIES) runs a subset of the auxiliary
+# measurements — the perf-sentinel CI step runs only the CHEAP entries
+# against the committed bench_baseline.json instead of the whole ~hour-long
+# record. The headline sweep always runs (it IS the metric).
+
+_ALL_ENTRIES = (
+    "speculative", "continuous", "resilience", "integrity", "profiling",
+    "fleet", "overload", "fairness", "prefix_cache", "capacity",
+    "large_sweep", "phase2_listwise", "flash_proof", "int8_70b",
+    "shard70b", "live8b",
+)
+
+_entries: "set | None" = None  # None = everything
+
+
+class _SkippedEntry(Exception):
+    """Raised inside an entry's try block when --entries excludes it."""
+
+
+def _enabled(name: str) -> bool:
+    return _entries is None or name in _entries
+
+
+def _require_entry(name: str) -> None:
+    if not _enabled(name):
+        raise _SkippedEntry(name)
+
+
+def set_entries(names) -> None:
+    global _entries
+    if names is None:
+        _entries = None
+        return
+    bad = set(names) - set(_ALL_ENTRIES)
+    if bad:
+        raise SystemExit(f"unknown bench entries: {sorted(bad)} "
+                         f"(choose from {', '.join(_ALL_ENTRIES)})")
+    _entries = set(names)
+
+
+# -- harness fingerprint + machine-readable baseline (ISSUE 12) ----------------
+
+
+def _cpu_model() -> str:
+    """Best-effort host CPU identity: ISA family plus the model name when
+    readable. XLA-CPU codegen is host-target dependent (AVX2 vs AVX-512
+    changes reduction order, which can flip near-tie argmax tokens), so
+    exact-compared token checksums are only meaningful on one CPU model —
+    the fingerprint must refuse across them."""
+    import platform
+
+    model = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        model = platform.processor() or ""
+    return f"{platform.machine()} {model}".strip()
+
+
+def harness_fingerprint(model_name: str) -> dict:
+    """What makes two bench runs comparable: same jax, same backend, same
+    chip kind, same host CPU, same host parallelism, same model.
+    tools/perf_sentinel.py REFUSES to compare runs whose fingerprints
+    differ — a number recorded on a v5e means nothing next to one from a
+    4-core CI runner."""
+    devices = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "platform": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "cpu": _cpu_model(),
+        "cpu_count": os.cpu_count(),
+        "model": model_name,
+    }
+
+
+def baseline_entries(result: dict) -> dict:
+    """Flatten a bench result into sentinel-comparable entries, each tagged
+    ``kind``: ``wall`` metrics carry harness jitter (compared within a
+    noise-aware ratio band) while ``exact`` counters (hit ratios, token
+    counts/checksums, shed counts) are deterministic on one fingerprint and
+    compared exactly — drift there is a correctness regression, not noise."""
+    d = result.get("detail", {})
+    entries: dict = {}
+
+    def wall(name, value, better="higher"):
+        # ``better`` is the improvement direction ("higher" for rates and
+        # speedups, "lower" for on/off overhead ratios) — the sentinel's
+        # best-of-N merge keeps the best rep PER THIS DIRECTION.
+        if value is not None:
+            entries[name] = {"kind": "wall", "value": float(value),
+                             "better": better}
+
+    def exact(name, value):
+        if value is not None:
+            entries[name] = {"kind": "exact", "value": value}
+
+    wall("headline.profiles_per_sec", result.get("value"))
+    wall("headline.decode_tokens_per_sec", d.get("decode_tokens_per_sec"))
+    exact("headline.token_checksum", d.get("token_checksum"))
+    c = d.get("continuous")
+    if c:
+        wall("continuous.tokens_per_sec",
+             c.get("continuous", {}).get("tokens_per_sec"))
+        wall("continuous.speedup", c.get("speedup_tokens_per_sec"))
+        exact("continuous.useful_tokens",
+              c.get("continuous", {}).get("useful_tokens"))
+    s = d.get("speculative")
+    if s:
+        wall("speculative.speedup", s.get("speedup"))
+        exact("speculative.acceptance_rate", s.get("acceptance_rate"))
+        exact("speculative.verify_steps", s.get("verify_steps"))
+    p = d.get("prefix_cache")
+    if p:
+        exact("prefix_cache.hit_ratio", p.get("on", {}).get("hit_ratio"))
+        exact("prefix_cache.prefill_tokens_on",
+              p.get("on", {}).get("prefill_tokens"))
+        exact("prefix_cache.prefill_token_reduction",
+              p.get("prefill_token_reduction"))
+        wall("prefix_cache.speedup_ratio", p.get("speedup_ratio"))
+    ov = d.get("overload_overhead")
+    if ov:
+        wall("overload.overhead_ratio", ov.get("overhead_ratio"),
+             better="lower")
+    pr = d.get("profiling_overhead")
+    if pr:
+        wall("profiling.overhead_ratio", pr.get("overhead_ratio"),
+             better="lower")
+    cap = d.get("capacity")
+    if cap:
+        for n, row in (cap.get("capacity") or {}).items():
+            wall(f"capacity.{n}.profiles_per_sec_per_chip",
+                 row.get("profiles_per_sec_per_chip"))
+            exact(f"capacity.{n}.shed_rate", row.get("shed_rate"))
+    return entries
+
+
+def write_bench_baseline(result: dict, path: str, model_name: str) -> str:
+    """Write the machine-readable baseline tools/perf_sentinel.py compares
+    against: per-entry metric + kind + the harness fingerprint."""
+    baseline = {
+        "schema_version": 1,
+        "created_at_unix": time.time(),
+        "fingerprint": harness_fingerprint(model_name),
+        "entries": baseline_entries(result),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
 
 # The bytes-per-step roofline model moved into the telemetry layer (ISSUE 7)
 # so serving evaluates it LIVE per decode chunk; bench (and the tools that
@@ -1424,6 +1580,19 @@ def measure_phase2_listwise(config, settings_cls) -> dict | None:
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entries", default=os.environ.get("BENCH_ENTRIES"),
+                    help="comma-separated subset of auxiliary entries to "
+                         f"run (default: all). Choices: {', '.join(_ALL_ENTRIES)}")
+    ap.add_argument("--baseline-out",
+                    default=os.environ.get("BENCH_BASELINE_OUT"),
+                    help="also write the machine-readable perf-sentinel "
+                         "baseline (entries + harness fingerprint) here")
+    args = ap.parse_args()
+    if args.entries:
+        set_entries([e.strip() for e in args.entries.split(",") if e.strip()])
     # The tunneled TPU occasionally drops one remote_compile mid-run
     # ("response body closed" / HTTP 500); one retry with fresh engines
     # recovers it. The driver runs this file ONCE per round — losing the
@@ -1433,7 +1602,7 @@ def main() -> None:
     # engine's HBM buffers before attempt 2 allocates fresh ones.
     for attempt in (1, 2):
         try:
-            _run()
+            _run(baseline_out=args.baseline_out)
             return
         except Exception as e:  # noqa: BLE001 — transient-tunnel retry
             if attempt == 2:
@@ -1442,7 +1611,7 @@ def main() -> None:
                   file=sys.stderr)
 
 
-def _run() -> None:
+def _run(baseline_out: "str | None" = None) -> None:
     from fairness_llm_tpu.config import ModelSettings
     from fairness_llm_tpu.models.configs import get_model_config
     from fairness_llm_tpu.runtime.engine import DecodeEngine
@@ -1467,11 +1636,22 @@ def _run() -> None:
 
     # Timed runs.
     times = []
+    token_checksum = None
     for rep in range(3):
         t0 = time.perf_counter()
         out = engine.generate(prompts, settings, seed=rep + 1)
         jax.block_until_ready(out.tokens)
         times.append(time.perf_counter() - t0)
+        if rep == 0:
+            # Token-parity witness for the perf sentinel: the seed-1 sweep
+            # is deterministic on one harness fingerprint, so a checksum
+            # drift is a correctness regression (compared EXACTLY), unlike
+            # the walls (compared within noise bands).
+            import hashlib
+
+            token_checksum = hashlib.sha256(
+                out.tokens.tobytes()
+            ).hexdigest()[:16]
 
     # Fused decode-attention kernel A/B on the same sweep (measured slower —
     # kept in the record so the regression/improvement trend is visible per
@@ -1520,7 +1700,8 @@ def _run() -> None:
     # alive (it reuses the params; only two more compiled programs).
     speculative = None
     try:
-        speculative = measure_speculative(engine, prompts, ModelSettings)
+        if _enabled("speculative"):
+            speculative = measure_speculative(engine, prompts, ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"speculative A/B skipped: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -1528,7 +1709,8 @@ def _run() -> None:
     # serving/ scheduler on a mixed-length workload, same engine/params.
     continuous = None
     try:
-        continuous = measure_continuous(engine, prompts, ModelSettings)
+        if _enabled("continuous"):
+            continuous = measure_continuous(engine, prompts, ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"continuous serving A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1538,7 +1720,9 @@ def _run() -> None:
     # stay within harness noise (docs/PERFORMANCE.md).
     resilience = None
     try:
-        resilience = measure_resilience_overhead(engine, prompts, ModelSettings)
+        if _enabled("resilience"):
+            resilience = measure_resilience_overhead(engine, prompts,
+                                                     ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"resilience overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1548,7 +1732,9 @@ def _run() -> None:
     # reduction must stay within harness noise, and the tokens identical.
     integrity = None
     try:
-        integrity = measure_integrity_overhead(engine, prompts, ModelSettings)
+        if _enabled("integrity"):
+            integrity = measure_integrity_overhead(engine, prompts,
+                                                   ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"integrity overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1559,7 +1745,9 @@ def _run() -> None:
     # identical; the on mode reports step_gap_s p50/p95 next to tokens/sec.
     profiling = None
     try:
-        profiling = measure_profiling_overhead(engine, prompts, ModelSettings)
+        if _enabled("profiling"):
+            profiling = measure_profiling_overhead(engine, prompts,
+                                                   ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"profiling overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1570,7 +1758,8 @@ def _run() -> None:
     # injected replica crash (fence -> first migrated token).
     fleet = None
     try:
-        fleet = measure_fleet(engine, prompts, ModelSettings)
+        if _enabled("fleet"):
+            fleet = measure_fleet(engine, prompts, ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"fleet A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1581,7 +1770,9 @@ def _run() -> None:
     # the controller pinned at level 0 throughout.
     overload = None
     try:
-        overload = measure_overload_overhead(engine, prompts, ModelSettings)
+        if _enabled("overload"):
+            overload = measure_overload_overhead(engine, prompts,
+                                                 ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"overload overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1592,7 +1783,9 @@ def _run() -> None:
     # pair joined with zero divergence.
     fairness = None
     try:
-        fairness = measure_fairness_overhead(engine, prompts, ModelSettings)
+        if _enabled("fairness"):
+            fairness = measure_fairness_overhead(engine, prompts,
+                                                 ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"fairness overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1602,7 +1795,9 @@ def _run() -> None:
     # measured prefill-token reduction, and the hit rate, parity asserted.
     prefix_cache = None
     try:
-        prefix_cache = measure_prefix_cache(engine, prompts, ModelSettings)
+        if _enabled("prefix_cache"):
+            prefix_cache = measure_prefix_cache(engine, prompts,
+                                                ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"prefix cache A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1612,7 +1807,8 @@ def _run() -> None:
     # attainment vs shed rate, token parity across sizes asserted.
     capacity = None
     try:
-        capacity = measure_capacity(engine, prompts, ModelSettings)
+        if _enabled("capacity"):
+            capacity = measure_capacity(engine, prompts, ModelSettings)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"capacity sweep skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1634,6 +1830,7 @@ def _run() -> None:
     big_rate_int8w = None
     big8w_stats = None
     try:
+        _require_entry("large_sweep")
         big = list(prompts) * 4
         engine.generate(big, settings, seed=0)
         t0 = time.perf_counter()
@@ -1751,6 +1948,8 @@ def _run() -> None:
                     big_rate_int8_kernel = len(big8) / (time.perf_counter() - t0)
                 finally:
                     del eng8k
+    except _SkippedEntry:
+        pass
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"large-sweep measurement skipped: {type(e).__name__}", file=sys.stderr)
 
@@ -1774,29 +1973,32 @@ def _run() -> None:
     del engine, out
     achievable_gbps = measure_achievable_gbps()
     phase2_listwise = None
-    for attempt in (1, 2):  # transient tunnel drops cost one compile; retry once
-        try:
-            phase2_listwise = measure_phase2_listwise(config, ModelSettings)
-            break
-        except Exception as e:  # noqa: BLE001 — auxiliary measurement only
-            print(
-                f"phase2-listwise attempt {attempt} failed: {type(e).__name__}: {e}",
-                file=sys.stderr,
-            )
-    flash_proof = flash_memory_proof()
-    int8_70b = int8_70b_fit()
+    if _enabled("phase2_listwise"):
+        for attempt in (1, 2):  # transient tunnel drops cost one compile; retry once
+            try:
+                phase2_listwise = measure_phase2_listwise(config, ModelSettings)
+                break
+            except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+                print(
+                    f"phase2-listwise attempt {attempt} failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+    flash_proof = flash_memory_proof() if _enabled("flash_proof") else None
+    int8_70b = int8_70b_fit() if _enabled("int8_70b") else None
 
     # Big-model live sections (each owns most of HBM; they run only after
     # every other engine is freed, serially). Fail-soft: a tunnel drop loses
     # the section, not the round's record.
     shard70b = None
     try:
-        shard70b = llama70b_shard_live()
+        if _enabled("shard70b"):
+            shard70b = llama70b_shard_live()
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"70B shard live skipped: {type(e).__name__}: {e}", file=sys.stderr)
     live8b = None
     try:
-        live8b = llama3_8b_live(achievable_gbps)
+        if _enabled("live8b"):
+            live8b = llama3_8b_live(achievable_gbps)
     except Exception as e:  # noqa: BLE001 — auxiliary measurement only
         print(f"8B live skipped: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -1925,6 +2127,7 @@ def _run() -> None:
             "profiles": len(prompts),
             "max_new_tokens": MAX_NEW_TOKENS,
             "decode_tokens_per_sec": round(tokens_per_sec, 1),
+            "token_checksum": token_checksum,
             "best_wall_s": round(best, 3),
             "all_wall_s": [round(t, 3) for t in times],
             "decode_shape": sweep_stats,
@@ -1974,6 +2177,9 @@ def _run() -> None:
         },
     }
     print(json.dumps(result))
+    if baseline_out:
+        path = write_bench_baseline(result, baseline_out, model_name)
+        print(f"bench baseline: {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

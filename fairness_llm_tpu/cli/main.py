@@ -573,6 +573,13 @@ def telemetry_report(argv) -> int:
                 print(f"  - {p}")
             return 1
     print(render_report(snap))
+    from fairness_llm_tpu.telemetry import has_cost_data, render_cost_report
+
+    if has_cost_data(snap):
+        # Cost-ledger section rides along whenever the run recorded the
+        # jaxpr cost walk (telemetry/costmodel.py) — the standalone
+        # `perf-report` subcommand renders the same decomposition alone.
+        print("\n" + render_cost_report(snap))
     if any(row.get("labels", {}).get("component") == "fairness"
            for section in ("counters", "gauges")
            for row in snap.get(section, [])):
@@ -594,6 +601,39 @@ def telemetry_report(argv) -> int:
                   "--trace-out or --telemetry-dir to produce one)")
     if a.validate:
         print("\nsnapshot schema: OK")
+    return 0
+
+
+def perf_report(argv) -> int:
+    """``cli perf-report <dir|snapshot.json>`` — render the decode cost
+    ledger and per-program gap attribution a run recorded
+    (telemetry/costmodel.py): per compiled program, the jaxpr-walked
+    bytes/FLOPs per component, the analytic floor, and the decomposition
+    ``measured wall + host gap = floor + dispatch + unattributed + host
+    gap`` with the top gap contributor named — the live replacement for
+    the offline xplane accounting in tools/account_decode_step.py. See
+    docs/PERFORMANCE.md §Round 12."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu perf-report",
+        description="Render the decode cost ledger / gap attribution from "
+                    "a telemetry snapshot",
+    )
+    ap.add_argument("path", help="telemetry dir (uses telemetry_snapshot."
+                                 "json inside) or a snapshot file")
+    ap.add_argument("--require-ledger", action="store_true",
+                    help="exit non-zero when the snapshot has no cost-"
+                         "ledger data (a CI gate)")
+    a = ap.parse_args(argv)
+    from fairness_llm_tpu.telemetry import (
+        has_cost_data,
+        load_snapshot,
+        render_cost_report,
+    )
+
+    snap = load_snapshot(a.path)
+    print(render_cost_report(snap))
+    if a.require_ledger and not has_cost_data(snap):
+        return 1
     return 0
 
 
@@ -789,6 +829,8 @@ def main(argv=None) -> int:
         # Subcommand dispatch ahead of the study parser (whose --all/--phase
         # group is required and would reject it).
         return telemetry_report(argv[1:])
+    if argv and argv[0] == "perf-report":
+        return perf_report(argv[1:])
     if argv and argv[0] == "slo-report":
         return slo_report(argv[1:])
     if argv and argv[0] == "fairness-report":

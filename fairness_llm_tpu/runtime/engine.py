@@ -55,6 +55,7 @@ from fairness_llm_tpu.runtime.sampling import (
 from fairness_llm_tpu.runtime.speculative import ngram_draft
 from fairness_llm_tpu.telemetry import get_registry
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.costmodel import instrument_jit, note_invocation
 from fairness_llm_tpu.telemetry.roofline import observe_decode
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.utils.profiling import SpeculationStats
@@ -298,7 +299,7 @@ class DecodeEngine:
                     out.append((layer.k[0], layer.v[0]))
             return tuple(out)
 
-        fn = jax.jit(run)
+        fn = instrument_jit(run, "prefix")
         self._compiled[key] = fn
         return fn
 
@@ -393,7 +394,10 @@ class DecodeEngine:
             return toks  # [B, max_new]
 
         # shared_layers is a pytree arg: None (empty pytree) when no prefix.
-        fn = jax.jit(run)
+        # instrument_jit = jax.jit + the cost ledger (telemetry/costmodel.py):
+        # the first attribution-on call walks the program's jaxpr into
+        # cost_ledger_bytes/flops{program="decode"} gauges.
+        fn = instrument_jit(run, "decode")
         self._compiled[key] = fn
         return fn
 
@@ -576,7 +580,7 @@ class DecodeEngine:
             )
             return gen[:, :max_new], out_len, counters
 
-        fn = jax.jit(run)
+        fn = instrument_jit(run, "spec_decode")
         self._compiled[key] = fn
         return fn
 
@@ -1018,4 +1022,13 @@ class DecodeEngine:
         # scheduler's per-chunk numbers are the precise ones.
         observe_decode(self.config, stats, steps_done, wall_mono,
                        program="spec_decode" if use_spec else "decode")
+        # Gap attribution (telemetry/costmodel.py): this call's measured
+        # wall + trip count against the compiled program's analytic ledger.
+        # Calls that grew the compile cache are compile-dominated (the
+        # watchdog-exemption condition) and tagged so the decomposition
+        # names compile instead of inflating "unattributed".
+        note_invocation("spec_decode" if use_spec else "decode", wall_mono,
+                        steps_done, ledger=getattr(fn, "ledger", None),
+                        compiling=any(k[0] != "prefix_kv" for k in
+                                      set(self._compiled) - keys_before))
         return GenerateOutput(texts=texts, tokens=out, steps=max_new, stats=stats)

@@ -118,6 +118,7 @@ from fairness_llm_tpu.telemetry import (
     get_registry,
 )
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.costmodel import instrument_jit, note_invocation
 from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
 from fairness_llm_tpu.telemetry.roofline import observe_decode
 from fairness_llm_tpu.telemetry.timeline import get_timeline
@@ -418,8 +419,9 @@ class ContinuousScheduler:
 
         # No donation here even on TPU: a prefill failure must leave the
         # OTHER live slots' cache rows intact, and a donated input buffer
-        # doesn't survive a raised call.
-        fn = jax.jit(run)
+        # doesn't survive a raised call. instrument_jit = jax.jit + the cost
+        # ledger (telemetry/costmodel.py) on every compiled program.
+        fn = instrument_jit(run, "serve_prefill")
         self._compiled[key] = fn
         return fn
 
@@ -514,7 +516,7 @@ class ContinuousScheduler:
                 jax.lax.while_loop(cond, body, init)
             return cache, prev_logits, toks, emitted, counters
 
-        fn = jax.jit(run, donate_argnums=self._donate())
+        fn = instrument_jit(run, "serve_step", donate_argnums=self._donate())
         self._compiled[key] = fn
         return fn
 
@@ -596,7 +598,7 @@ class ContinuousScheduler:
 
         # Not donated, like the plain prefill: a raised call must leave the
         # other live slots' arena blocks intact.
-        fn = jax.jit(run)
+        fn = instrument_jit(run, "paged_prefill")
         self._compiled[key] = fn
         return fn
 
@@ -677,7 +679,7 @@ class ContinuousScheduler:
                 return arena, c[2], c[5], c[4], c[6], c[7]
             return arena, c[2], c[5], c[4], c[6]
 
-        fn = jax.jit(run, donate_argnums=self._donate())
+        fn = instrument_jit(run, "paged_step", donate_argnums=self._donate())
         self._compiled[key] = fn
         return fn
 
@@ -1405,11 +1407,18 @@ class ContinuousScheduler:
             f"prefill[{nb}x{P}]", "prefill", self._track, pf_t0, pf_wall,
             rows=len(admitted),
         )
+        # Busy-cursor mark: a prefill between two decode chunks must not
+        # count as the cost ledger's "host gap" (it is attributed to
+        # serve_prefill by note_invocation below).
+        get_timeline().note_busy(self._track, pf_t0, pf_wall)
         if first_compile:
             record_compile("serve_prefill", reason="shape", seconds=pf_wall,
                            track=self._track, key=("serve_prefill", nb, P,
                                                    guard),
                            labels=self.labels, t0=pf_t0)
+        note_invocation("serve_prefill", pf_wall,
+                        ledger=getattr(fn, "ledger", None),
+                        compiling=first_compile)
         stats.prefill_batches += 1
         stats.prefill_tokens += int(tb.lengths.sum())
         stats.admitted += len(admitted)
@@ -1584,11 +1593,15 @@ class ContinuousScheduler:
             f"prefill[{nb}x{S}]", "prefill", self._track, pf_t0, pf_wall,
             rows=len(rows), matched_prefix_tokens=int(matched.sum()),
         )
+        get_timeline().note_busy(self._track, pf_t0, pf_wall)
         if first_compile:
             record_compile("paged_prefill", reason="shape", seconds=pf_wall,
                            track=self._track,
                            key=("paged_prefill", nb, S, guard),
                            labels=self.labels, t0=pf_t0)
+        note_invocation("paged_prefill", pf_wall,
+                        ledger=getattr(fn, "ledger", None),
+                        compiling=first_compile)
         stats.prefill_batches += 1
         # Suffix tokens only: the hit/miss counters hold the reuse story,
         # and this total IS the measured prefill-token reduction.
@@ -1767,7 +1780,8 @@ class ContinuousScheduler:
         # batch is num_slots, not len(live_ids).
         dc_wall = now - dc_t0
         get_timeline().decode_chunk(self._track, dc_t0, dc_wall, steps,
-                                    labels=self.labels, rows=len(live_ids))
+                                    labels=self.labels, rows=len(live_ids),
+                                    program=step_key[0])
         if first_compile:
             record_compile(
                 step_key[0],
@@ -1778,12 +1792,25 @@ class ContinuousScheduler:
                 key=step_key,
                 labels=self.labels, t0=dc_t0,
             )
+        roof_stats = {"batch": self.num_slots, "cache_slots": self.cache_len,
+                      "prefix_len": 0}
+        if self.paged:
+            # Paged-KV traffic model (telemetry/roofline.py): the per-chunk
+            # gather/scatter copies between the block arena and the
+            # contiguous view move real bytes the contiguous-layout model
+            # omits — amortized over the steps this chunk actually ran.
+            roof_stats.update(paged_kv=True, chunk_steps=steps)
         observe_decode(
-            self.engine.config,
-            {"batch": self.num_slots, "cache_slots": self.cache_len,
-             "prefix_len": 0},
-            steps, dc_wall, program="serve_step", labels=self.labels,
+            self.engine.config, roof_stats,
+            steps, dc_wall, program=step_key[0], labels=self.labels,
         )
+        # Gap attribution (telemetry/costmodel.py): the chunk's measured
+        # wall + trip count against the step program's analytic ledger. A
+        # first-compile chunk's wall is tagged so the decomposition shows
+        # compile as its own contributor, not "unattributed in-step".
+        note_invocation(step_key[0], dc_wall, steps,
+                        ledger=getattr(fn, "ledger", None),
+                        compiling=first_compile)
         # Per-chunk pool-pressure samples, weighted by the steps the chunk
         # actually ran (the compiled loop may exit early): live rows at
         # entry is the occupancy every one of those steps decoded at most.

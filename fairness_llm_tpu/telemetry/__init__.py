@@ -77,6 +77,21 @@ from fairness_llm_tpu.telemetry.timeline import (
     validate_chrome_trace,
 )
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
+from fairness_llm_tpu.telemetry.costmodel import (
+    COMPONENT_TITLES,
+    COMPONENTS,
+    CostLedger,
+    classify,
+    classify_eqn,
+    gap_decomposition,
+    has_cost_data,
+    instrument_jit,
+    jaxpr_ledger,
+    note_invocation,
+    render_cost_report,
+    set_achievable_gflops,
+    set_dispatch_s,
+)
 from fairness_llm_tpu.telemetry.fairness import (
     FairnessMonitor,
     get_fairness_monitor,
@@ -185,6 +200,19 @@ __all__ = [
     "summarize_chrome_trace",
     "note_lookup",
     "record_compile",
+    "COMPONENTS",
+    "COMPONENT_TITLES",
+    "CostLedger",
+    "classify",
+    "classify_eqn",
+    "gap_decomposition",
+    "has_cost_data",
+    "instrument_jit",
+    "jaxpr_ledger",
+    "note_invocation",
+    "render_cost_report",
+    "set_achievable_gflops",
+    "set_dispatch_s",
     "FairnessMonitor",
     "get_fairness_monitor",
     "set_fairness_monitor",

@@ -70,6 +70,13 @@ class Timeline:
         # Per-track end time of the last decode chunk (the step-gap cursor);
         # cleared at drain end so inter-drain idle never counts as a gap.
         self._last_chunk_end: Dict[str, float] = {}
+        # Per-track end of the last KNOWN-BUSY device interval (decode
+        # chunks AND prefill batches via note_busy): the cost-ledger host
+        # gap measures time the device was actually idle between chunks,
+        # while step_gap_s keeps its PR-7 semantics (ALL between-chunk
+        # host time, prefill included — that is the fused-multi-step
+        # opportunity window).
+        self._last_busy_end: Dict[str, float] = {}
         self.top_gaps: List[Tuple[float, float, str]] = []  # (gap_s, t, track)
         self._epoch: Optional[float] = None
 
@@ -117,11 +124,15 @@ class Timeline:
                     "args": {"outcome": outcome, **args}})
 
     def decode_chunk(self, track: str, t0: float, dur_s: float, steps: int,
-                     labels: Optional[Dict[str, str]] = None, **args) -> None:
+                     labels: Optional[Dict[str, str]] = None,
+                     program: Optional[str] = None, **args) -> None:
         """A decode-chunk span, plus the step-gap accounting: the time from
         the previous chunk's end (same track) to this chunk's start is
         host-side sync/admission work the device spent idle — observed into
-        the ``step_gap_s`` histogram and stamped onto the span."""
+        the ``step_gap_s`` histogram and stamped onto the span. With
+        ``program`` set, the gap ALSO accumulates into the per-program
+        ``cost_host_gap_s_total`` gauge — the MEASURED host-gap term of the
+        cost-ledger gap decomposition (telemetry/costmodel.py)."""
         if not self.enabled:
             return
         gap = None
@@ -131,20 +142,49 @@ class Timeline:
             get_registry().histogram(
                 "step_gap_s", component="serving", **(labels or {})
             ).observe(gap)
+            if program is not None:
+                # Unlabeled by replica, like the other cost_* accumulators:
+                # the decomposition is per program, replicas fold together.
+                # Measured against the BUSY cursor, not the chunk cursor —
+                # a prefill between two chunks is attributed to its own
+                # program by note_invocation, so counting it here too
+                # would double-attribute it as "host gap".
+                busy_end = max(last_end,
+                               self._last_busy_end.get(track, last_end))
+                get_registry().gauge(
+                    "cost_host_gap_s_total", component="costmodel",
+                    program=program,
+                ).add(max(t0 - busy_end, 0.0))
             self.top_gaps.append((gap, t0, track))
             self.top_gaps.sort(reverse=True)
             del self.top_gaps[_TOP_GAPS:]
         self._last_chunk_end[track] = t0 + dur_s
+        self.note_busy(track, t0, dur_s)
         if gap is not None:
             args = {**args, "gap_s": round(gap, 6)}
+        if program is not None:
+            args = {**args, "program": program}
         self.record_span(f"decode_chunk[{steps}]", "decode", track, t0,
                          dur_s, steps=steps, **args)
+
+    def note_busy(self, track: str, t0: float, dur_s: float) -> None:
+        """Mark ``[t0, t0+dur_s)`` as device-busy on ``track`` (a prefill
+        batch, a decode chunk) — consumed by the cost-ledger host-gap
+        measurement above. No event is recorded; the caller's own span
+        does that."""
+        if not self.enabled:
+            return
+        end = float(t0) + max(float(dur_s), 0.0)
+        cur = self._last_busy_end.get(track)
+        if cur is None or end > cur:
+            self._last_busy_end[track] = end
 
     def clear_track_cursor(self, track: str) -> None:
         """Forget the last chunk end for ``track`` — called at drain end so
         the idle stretch before the next drain's first chunk is not a
         step gap."""
         self._last_chunk_end.pop(track, None)
+        self._last_busy_end.pop(track, None)
 
     # -- export --------------------------------------------------------------
 

@@ -13,35 +13,21 @@ from __future__ import annotations
 
 import json
 import os
-import re
 import sys
 import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Classification: first match wins. Names come from XLA's fusion/op naming in
-# the xplane capture (round-3/4 traces: multiply_reduce over score tensors,
-# dynamic-update-slice cache writes, async slice-starts for weight DMA).
-COMPONENTS = [
-    ("attention reductions", re.compile(
-        r"multiply_reduce|reduce_fusion|softmax|exponential|divide_fusion")),
-    ("cache writes (DUS)", re.compile(r"dynamic-update-slice|update_slice")),
-    ("weight DMA / slices", re.compile(r"^(slice|bitcast|copy)|slice-start|copy-start|copy-done|slice_fusion")),
-    ("matmuls (MXU)", re.compile(r"dot|matmul|convolution|einsum")),
-    ("norms/elementwise", re.compile(
-        r"rsqrt|norm|add_fusion|multiply_fusion|subtract|tanh|gelu|silu|logistic")),
-    ("sampling/argmax/rng", re.compile(r"sort|argmax|rng|random|iota|cumsum|select_n|compare")),
-    ("gather/embedding", re.compile(r"gather|scatter")),
-    ("loop/control", re.compile(r"while|condition|tuple|parameter|constant")),
-]
-
-
-def classify(name: str) -> str:
-    low = name.lower()
-    for label, pat in COMPONENTS:
-        if pat.search(low):
-            return label
-    return "other"
+# Classification: the SHARED component taxonomy (telemetry/costmodel.py) —
+# the same first-match-wins table the live jaxpr cost ledger publishes
+# under, so an offline xplane capture and the live cost_ledger_bytes gauges
+# bucket work identically. The patterns/order are the ones this tool owned
+# through round 11 (regression-pinned in tests/test_costmodel.py).
+from fairness_llm_tpu.telemetry.costmodel import (  # noqa: E402
+    COMPONENT_TITLES,
+    COMPONENTS,
+    classify,
+)
 
 
 def run(model_name: str = "gpt2-small") -> dict:
@@ -115,6 +101,7 @@ if __name__ == "__main__":
     comps = res.pop("components")
     print(json.dumps(res))
     for label, c in comps.items():
-        print(f"{c['ms']:9.1f} ms ({c['pct']:4.1f}%)  x{c['events']:7d}  {label}")
+        title = COMPONENT_TITLES.get(label, label)
+        print(f"{c['ms']:9.1f} ms ({c['pct']:4.1f}%)  x{c['events']:7d}  {title}")
         for ms, cnt, name in c["top_ops"][:3]:
             print(f"    {ms:8.2f} ms x{cnt:6d}  {name}")

@@ -52,6 +52,11 @@ smoke drill produces (ISSUE 11): at least one
 ``fleet_migrated_recovered_total``, and every fleet's
 ``fleet_healthy_replicas`` back to its ``fleet_replicas`` (the trace's
 crashed replica rejoined; retired replicas left the gauge entirely).
+``--require-costmodel`` requires the decode cost ledger (ISSUE 12):
+every program counted in ``compiles_total`` must have published a nonzero
+``cost_ledger_bytes`` gauge (the jaxpr-walked analytical bytes per
+component), plus nonzero ``cost_wall_s_total`` accumulation so the
+``perf-report`` gap decomposition is derivable from the snapshot.
 ``--require-fairness`` requires the fairness-observability signals a
 fault-free ``--fairness-obs --continuous`` study produces (ISSUE 9):
 nonzero ``fairness_requests_total`` and ``fairness_pairs_joined_total``,
@@ -83,11 +88,14 @@ def check(path: str, require_serving: bool = False,
           require_overload: bool = False,
           require_fairness: bool = False,
           require_prefix_cache: bool = False,
-          require_autoscale: bool = False) -> int:
+          require_autoscale: bool = False,
+          require_costmodel: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
     if require_profile:
         problems.extend(_check_profile(path, snap))
+    if require_costmodel:
+        problems.extend(_check_costmodel(snap))
     if require_fairness:
         problems.extend(_check_fairness(snap))
     if require_autoscale:
@@ -237,6 +245,49 @@ def check(path: str, require_serving: bool = False,
           f"({len(snap.get('counters', []))} counters, "
           f"{len(snap.get('histograms', []))} histograms)")
     return 0
+
+
+def _check_costmodel(snap: dict) -> list:
+    """The --require-costmodel gate (ISSUE 12): every compiled program seen
+    in ``compiles_total`` published a nonzero jaxpr-walked cost ledger, and
+    the gap-attribution accumulators the ``perf-report`` decomposition
+    needs (measured wall + per-component floor) are populated."""
+    problems = []
+    compiled = sorted({
+        c.get("labels", {}).get("program")
+        for c in snap.get("counters", [])
+        if c.get("name") == "compiles_total" and c.get("value")
+    } - {None})
+    if not compiled:
+        problems.append("compiles_total is empty (no compiled program to "
+                        "require a ledger for)")
+    ledgered = {}
+    for g in snap.get("gauges", []):
+        if g.get("name") != "cost_ledger_bytes":
+            continue
+        prog = g.get("labels", {}).get("program")
+        ledgered[prog] = ledgered.get(prog, 0.0) + float(g.get("value", 0.0))
+    for prog in compiled:
+        if ledgered.get(prog, 0.0) <= 0:
+            problems.append(
+                f"compiled program {prog!r} has no nonzero cost_ledger_bytes "
+                "gauge (the jaxpr cost walk never ran for it)"
+            )
+    walls = {
+        g.get("labels", {}).get("program"): float(g.get("value", 0.0))
+        for g in snap.get("gauges", [])
+        if g.get("name") == "cost_wall_s_total"
+    }
+    if not any(v > 0 for v in walls.values()):
+        problems.append("no nonzero cost_wall_s_total gauge (gap "
+                        "attribution has no measured wall to decompose)")
+    floors = [g for g in snap.get("gauges", [])
+              if g.get("name") == "cost_component_min_s_total"
+              and g.get("value", 0.0) > 0]
+    if not floors:
+        problems.append("cost_component_min_s_total is empty (no invocation "
+                        "ever folded its ledger into the floor)")
+    return problems
 
 
 def _check_autoscale(snap: dict) -> list:
@@ -493,6 +544,7 @@ def main() -> int:
     ap.add_argument("--require-fairness", action="store_true")
     ap.add_argument("--require-prefix-cache", action="store_true")
     ap.add_argument("--require-autoscale", action="store_true")
+    ap.add_argument("--require-costmodel", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
@@ -502,7 +554,8 @@ def main() -> int:
                  require_overload=a.require_overload,
                  require_fairness=a.require_fairness,
                  require_prefix_cache=a.require_prefix_cache,
-                 require_autoscale=a.require_autoscale)
+                 require_autoscale=a.require_autoscale,
+                 require_costmodel=a.require_costmodel)
 
 
 if __name__ == "__main__":
