@@ -280,6 +280,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the copy the validator and --timeline report "
                         "read); this flag adds an extra copy at PATH, or "
                         "enables the export without a telemetry dir")
+    p.add_argument("--incidents", action="store_true",
+                   help="arm the incident engine (telemetry/incidents.py): "
+                        "trigger conditions (breaker open, fence, hang, "
+                        "numerics fault, canary mismatch, fairness "
+                        "divergence/alert, error-budget burn, heartbeat "
+                        "gap) dump self-contained postmortem bundles under "
+                        "<telemetry-dir>/incidents — flight-recorder rings, "
+                        "decision trail, registry snapshot, trace slice, "
+                        "journal tail. Render with `incident-report <dir>`; "
+                        "gate with tools/validate_telemetry.py "
+                        "--require-incidents / --forbid-incidents. "
+                        "Requires --telemetry-dir")
     p.add_argument("--fairness-obs", action="store_true",
                    help="fairness observability (telemetry/fairness.py): "
                         "phases register their profile grid with the "
@@ -716,6 +728,64 @@ def fairness_report(argv) -> int:
     return 0
 
 
+def incident_report(argv) -> int:
+    """``cli incident-report <bundle-dir | incidents-dir | telemetry-dir>``
+    — render incident postmortem bundles: manifest, the causal chain
+    derived from the decision trail ("fence(r1) <- 3x breaker trips <-
+    numerics faults <- requests a, b"), flight-recorder ring depths, and
+    the implicated decision tail. Given a telemetry dir (or an incidents
+    dir), renders every bundle inside; given one bundle, renders it alone.
+    See docs/OBSERVABILITY.md §Incidents."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu incident-report",
+        description="Render incident postmortem bundles",
+    )
+    ap.add_argument("path", help="one bundle dir, an incidents/ dir, or a "
+                                 "telemetry dir containing incidents/")
+    ap.add_argument("--chain-only", action="store_true",
+                    help="print only the one-line causal chain per bundle")
+    a = ap.parse_args(argv)
+    import os
+
+    from fairness_llm_tpu.telemetry import list_bundles, render_incident_report
+    from fairness_llm_tpu.telemetry.incidents import (
+        INCIDENTS_DIRNAME,
+        MANIFEST_FILENAME,
+        causal_chain,
+        _read_jsonl,
+    )
+
+    path = a.path.rstrip("/")
+    if os.path.isfile(os.path.join(path, MANIFEST_FILENAME)):
+        bundles = [path]
+    else:
+        inc_dir = path
+        if os.path.isdir(os.path.join(path, INCIDENTS_DIRNAME)):
+            inc_dir = os.path.join(path, INCIDENTS_DIRNAME)
+        bundles = [m["path"] for m in list_bundles(inc_dir)]
+        if not bundles:
+            print(f"no incident bundles under {inc_dir} — a clean run, or "
+                  "the engine was never armed (--incidents)")
+            return 0
+    for i, b in enumerate(bundles):
+        if a.chain_only:
+            import json as _json
+
+            with open(os.path.join(b, MANIFEST_FILENAME),
+                      encoding="utf-8") as f:
+                manifest = _json.load(f)
+            trail = _read_jsonl(os.path.join(b, "decisions.jsonl"))
+            implicated = _read_jsonl(
+                os.path.join(b, "decisions_implicated.jsonl"))
+            print(f"{os.path.basename(b)}: "
+                  + causal_chain(manifest, trail, implicated))
+        else:
+            if i:
+                print()
+            print(render_incident_report(b))
+    return 0
+
+
 def resume_serving_cmd(argv) -> int:
     """``cli resume-serving <journal-dir>`` — finish the unfinished.
 
@@ -835,6 +905,8 @@ def main(argv=None) -> int:
         return slo_report(argv[1:])
     if argv and argv[0] == "fairness-report":
         return fairness_report(argv[1:])
+    if argv and argv[0] == "incident-report":
+        return incident_report(argv[1:])
     if argv and argv[0] == "resume-serving":
         return resume_serving_cmd(argv[1:])
     args = build_parser().parse_args(argv)
@@ -847,10 +919,21 @@ def main(argv=None) -> int:
     check_setup(config)
     save = not args.no_save
     telemetry_sink = None
+    if args.incidents and not config.telemetry_dir:
+        raise SystemExit("--incidents requires --telemetry-dir (bundles "
+                         "dump under <telemetry-dir>/incidents)")
     if config.telemetry_dir:
         from fairness_llm_tpu import telemetry as T
 
         telemetry_sink = T.configure(config.telemetry_dir)
+        if args.incidents:
+            import os as _os
+
+            from fairness_llm_tpu.telemetry import arm_incidents
+            from fairness_llm_tpu.telemetry.incidents import INCIDENTS_DIRNAME
+
+            arm_incidents(_os.path.join(config.telemetry_dir,
+                                        INCIDENTS_DIRNAME))
     # Performance attribution setup (telemetry/slo.py, telemetry/roofline.py):
     # install the SLO objectives and the roofline reference BEFORE any
     # backend/scheduler is built, so every evaluator judges against the

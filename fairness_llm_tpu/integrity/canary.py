@@ -164,10 +164,36 @@ class CanaryProbe:
                     **self.labels).inc()
         reg.gauge("canary_last_ok", component=self.component,
                   **self.labels).set(1 if ok else 0)
+        # Decision audit trail (telemetry/incidents.py): every probe
+        # verdict, with the comparison inputs on a mismatch.
+        from fairness_llm_tpu.telemetry.incidents import (
+            maybe_trigger,
+            record_decision,
+        )
+
+        record_decision(
+            "canary", "ok" if ok else "mismatch",
+            signals=({} if ok else {
+                "finish_reason": res.finish_reason,
+                "got": [int(t) for t in got[:8]],
+                "expected": [int(t) for t in self.reference[:8]],
+            }),
+            request_id=req.id, replica=self.labels.get("replica"),
+        )
         if ok:
             return True
         reg.counter("canary_mismatch_total", component=self.component,
                     **self.labels).inc()
+        # Wrong-but-finite output is the nastiest incident class — the
+        # breakers may look healthy. Bundle the evidence before the trip
+        # below reshapes the ladder state.
+        maybe_trigger(
+            "canary_mismatch",
+            f"golden prompt decoded wrong tokens (finish_reason="
+            f"{res.finish_reason})",
+            scope=self.labels.get("replica") or self.component,
+            replica=self.labels.get("replica"), request_id=req.id,
+        )
         emit_event(
             "canary_mismatch", component=self.component,
             finish_reason=res.finish_reason,

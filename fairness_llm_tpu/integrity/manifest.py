@@ -204,6 +204,14 @@ def _fail(kind: str, path: str, problem: str) -> None:
     # EVENT kind.
     emit_event("manifest_failure", artifact_kind=kind, file=path,
                reason=problem)
+    # Incident engine (telemetry/incidents.py): corrupt bytes at rest are
+    # an incident — the bundle names the file and the digest problem, so
+    # "which artifact, corrupted how" survives the refused load.
+    from fairness_llm_tpu.telemetry.incidents import maybe_trigger
+
+    maybe_trigger("integrity_fault",
+                  f"manifest digest failure: {path}: {problem}",
+                  scope=kind, file=path)
     raise IntegrityError(f"integrity check failed for {path}: {problem}")
 
 
@@ -253,5 +261,13 @@ def verify_manifest_entry(
     reg.counter("manifest_failures_total", kind=kind).inc()
     emit_event("manifest_failure", artifact_kind=kind,
                file=os.path.join(directory, filename), reason=problem)
+    from fairness_llm_tpu.telemetry.incidents import maybe_trigger
+
+    maybe_trigger(
+        "integrity_fault",
+        f"manifest digest failure: {os.path.join(directory, filename)}: "
+        f"{problem}",
+        scope=kind, file=os.path.join(directory, filename),
+    )
     logger.warning("manifest mismatch (%s): %s", kind, problem)
     return False

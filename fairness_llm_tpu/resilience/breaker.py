@@ -49,6 +49,8 @@ import time
 from typing import Callable, Dict, Mapping, Optional, Tuple
 
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
+from fairness_llm_tpu.telemetry.incidents import maybe_trigger, record_decision
 
 logger = logging.getLogger(__name__)
 
@@ -111,6 +113,31 @@ class CircuitBreaker:
                    **self.labels)
         logger.warning("breaker[%s/%s]: %s -> %s", self.component, self.stage,
                        old, new)
+        # Incident engine (telemetry/incidents.py): the transition as a
+        # first-class decision with its input signal (the consecutive-fault
+        # count that drove it), a flight-recorder gauge edge, and — on the
+        # trip to OPEN — an incident trigger so the moment-of-failure state
+        # is captured while it still exists. Scope is the replica (or the
+        # component for the single-engine path), so one sick replica's
+        # fault storm dedups to one bundle however many stages it takes.
+        scope = self.labels.get("replica") or self.component
+        record_decision(
+            "breaker", f"{self.stage}:{old}->{new}",
+            signals={"consecutive_failures": self.consecutive_failures,
+                     "stage": self.stage},
+            replica=self.labels.get("replica"),
+        )
+        get_flight_recorder().transition(
+            "breaker_state", f"{scope}/{self.stage}", new, prev_state=old
+        )
+        if new == OPEN:
+            maybe_trigger(
+                "breaker_open",
+                f"{self.stage} breaker open after "
+                f"{self.consecutive_failures} consecutive failure(s)",
+                scope=scope, replica=self.labels.get("replica"),
+                stage=self.stage,
+            )
         if self.on_transition is not None:
             self.on_transition(self.stage, old, new)
 
@@ -195,6 +222,13 @@ class DegradationLadder:
         emit_event("degradation", component=self.component,
                    from_level=old, to_level=level, rung=self.RUNGS[level],
                    **self.labels)
+        scope = self.labels.get("replica") or self.component
+        record_decision(
+            "ladder", f"{old}->{level}",
+            signals={"rung": self.RUNGS[level]},
+            replica=self.labels.get("replica"),
+        )
+        get_flight_recorder().transition("degradation_level", scope, level)
         log = logger.warning if level > old else logger.info
         log("degradation[%s]: level %d (%s) -> %d (%s)", self.component,
             old, self.RUNGS[old], level, self.RUNGS[level])

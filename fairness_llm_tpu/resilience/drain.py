@@ -63,6 +63,12 @@ class ServingJournal:
         # Append mode: a resumed process extends the predecessor's ledger —
         # its unfinished records are exactly what the resume serves.
         self._f = open(self.path, "a", encoding="utf-8")
+        # Incident bundles (telemetry/incidents.py) include this ledger's
+        # tail — registration here, import lazily: the reverse edge
+        # (incidents importing resilience) would cycle.
+        from fairness_llm_tpu.telemetry.incidents import note_journal
+
+        note_journal(self.path)
 
     # -- writes --------------------------------------------------------------
 

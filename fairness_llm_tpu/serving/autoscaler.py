@@ -57,6 +57,8 @@ from typing import Dict, Optional
 
 from fairness_llm_tpu.config import AutoscaleConfig
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
+from fairness_llm_tpu.telemetry.incidents import record_decision
 
 logger = logging.getLogger(__name__)
 
@@ -241,12 +243,23 @@ class Autoscaler:
             self._count_event("up_denied")
             emit_event("autoscale_denied", reason=reason, **sig,
                        **self._labels)
+            # Decision audit trail (telemetry/incidents.py): the denial
+            # with the signals that wanted the standby — a postmortem of a
+            # capacity incident must show the controller TRIED.
+            record_decision("autoscale", "up_denied",
+                            signals={"reason": reason, **sig})
             return None
         self._denied_want = None
         self.scale_ups += 1
         self._count_event("up")
         emit_event("autoscale_up", replica=rep.name, reason=reason,
                    replicas=len(self.fleet.replicas), **sig, **self._labels)
+        record_decision("autoscale", "up",
+                        signals={"reason": reason, **sig},
+                        replica=rep.name)
+        get_flight_recorder().transition(
+            "fleet_replicas", self._labels.get("fleet") or "fleet",
+            len(self.fleet.replicas))
         logger.warning("autoscale UP -> %d replicas (%s): %s",
                        len(self.fleet.replicas), rep.name, reason)
         return "up"
@@ -267,6 +280,12 @@ class Autoscaler:
         self._count_event("down")
         emit_event("autoscale_down", replica=victim.name, migrated=migrated,
                    replicas=len(self.fleet.replicas), **sig, **self._labels)
+        record_decision("autoscale", "down",
+                        signals={"migrated": migrated, **sig},
+                        replica=victim.name)
+        get_flight_recorder().transition(
+            "fleet_replicas", self._labels.get("fleet") or "fleet",
+            len(self.fleet.replicas))
         logger.warning("autoscale DOWN -> %d replicas (retired %s, "
                        "%d migrated)", len(self.fleet.replicas),
                        victim.name, migrated)

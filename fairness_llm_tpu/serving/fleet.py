@@ -97,6 +97,7 @@ from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 from fairness_llm_tpu.telemetry import emit_event, get_registry
 from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
+from fairness_llm_tpu.telemetry.incidents import maybe_trigger, record_decision
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.utils.profiling import ServingStats
 from fairness_llm_tpu.utils.ratelimit import RateLimiter
@@ -369,6 +370,16 @@ class ReplicaSet:
             **self._fleet_labels,
         ).inc()
         self._shed_fleet += 1
+        # Decision audit trail (telemetry/incidents.py): the refusal with
+        # its inputs — rung and retry-after — keyed to the refused request.
+        ctl = self.shed_controller
+        record_decision(
+            "shed", reason,
+            signals={"qos": req.qos, "retry_after_s": retry_after,
+                     "level": ctl.level if ctl is not None else 0,
+                     "front_door": "fleet"},
+            request_id=req.id,
+        )
         # A fleet-intake shed is exactly the group-unequal treatment the
         # neutrality audit must see — no replica scheduler will ever
         # observe this request.
@@ -792,6 +803,27 @@ class ReplicaSet:
         migrated = self._evacuate(rep, reason)
         emit_event("replica_fence_complete", replica=rep.name,
                    reason=reason, migrated=migrated)
+        # Incident engine (telemetry/incidents.py): a fence IS an incident
+        # — capture the moment-of-failure state (breaker/ladder edges, the
+        # decision trail that inferred sickness, the migrated cohort)
+        # while it still exists. The decision carries the signal values;
+        # the trigger dumps the bundle (deduped per replica).
+        record_decision(
+            "fence", reason,
+            signals={"migrated": migrated,
+                     "health_score": round(get_registry().read_value(
+                         "replica_health_score", default=-1.0,
+                         component="fleet", replica=rep.name), 4),
+                     "open_breakers": (rep.sched.breakers.open_count()
+                                       if rep.sched.breakers is not None
+                                       else 0),
+                     "ladder_level": (rep.sched.breakers.ladder.level
+                                      if rep.sched.breakers is not None
+                                      else 0)},
+            replica=rep.name,
+        )
+        maybe_trigger("fence", f"replica {rep.name} fenced: {reason}",
+                      scope=rep.name, replica=rep.name, migrated=migrated)
 
     def _evacuate(self, rep: Replica, reason: str,
                   count_failover: bool = True) -> int:
@@ -969,6 +1001,12 @@ class ReplicaSet:
                 replica=rep.name,
             ).inc()
             emit_event("replica_rejoin_denied", replica=rep.name)
+            record_decision(
+                "rejoin", "denied",
+                signals={"fence_reason": rep.fence_reason,
+                         "fences": rep.fences},
+                replica=rep.name,
+            )
             logger.warning("replica %s failed its rejoin probe; staying "
                            "fenced", rep.name)
             return True
@@ -980,6 +1018,8 @@ class ReplicaSet:
                                replica=rep.name).inc()
         self._update_health_gauge()
         emit_event("replica_rejoined", replica=rep.name)
+        record_decision("rejoin", "ok", signals={"rejoins": rep.rejoins},
+                        replica=rep.name)
         get_timeline().record_instant("rejoin", rep.name)
         logger.warning("replica %s passed its rejoin probe; back in the "
                        "fleet", rep.name)

@@ -63,6 +63,8 @@ from typing import Deque, Dict, Optional, Tuple
 from fairness_llm_tpu.config import OverloadConfig
 from fairness_llm_tpu.serving.request import QOS_CLASSES, QOS_PRIORITY, Request
 from fairness_llm_tpu.telemetry import emit_event, get_registry
+from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
+from fairness_llm_tpu.telemetry.incidents import record_decision
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 
 logger = logging.getLogger(__name__)
@@ -321,6 +323,21 @@ class ShedController:
         frm, self.level = self.level, to
         escalating = to > frm
         self._gauge().set(to)
+        # Decision audit trail (telemetry/incidents.py): the rung move with
+        # the INPUT SIGNALS at decision time — the windowed queue fraction
+        # and the burn the controller judged — plus a flight-recorder gauge
+        # edge, so a postmortem shows why the ladder was where it was.
+        scope = self.labels.get("replica") \
+            or self.labels.get("fleet") or self.component
+        record_decision(
+            "overload", f"{frm}->{to}",
+            signals={"rung": SHED_LADDER[to], "reason": reason,
+                     "queue_frac": round(self._depth_frac(now), 3),
+                     "burn": round(self._burn(), 3)},
+            replica=self.labels.get("replica"),
+        )
+        get_flight_recorder().transition("overload_level", scope, to,
+                                         reason=reason)
         get_registry().counter(
             "overload_transitions_total", component=self.component,
             to=str(to), **self.labels,

@@ -48,6 +48,8 @@ from typing import List, Optional, Sequence
 from fairness_llm_tpu.config import FleetConfig
 from fairness_llm_tpu.resilience.breaker import HALF_OPEN, OPEN
 from fairness_llm_tpu.telemetry import get_registry
+from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
+from fairness_llm_tpu.telemetry.incidents import record_decision
 
 logger = logging.getLogger(__name__)
 
@@ -117,6 +119,12 @@ class HealthRouter:
         get_registry().gauge(
             "replica_health_score", component="fleet", replica=replica.name
         ).set(score)
+        # Flight-recorder gauge edge, deduped on value: scoring runs per
+        # admission, but only CHANGES land in the ring — the postmortem
+        # reads the health trajectory without a per-pick flood.
+        get_flight_recorder().transition(
+            "replica_health_score", replica.name, round(score, 4)
+        )
         return score
 
     def load(self, replica) -> float:
@@ -172,9 +180,24 @@ class HealthRouter:
                     weight == calm_weight and rep.name < calm_best.name
                 ):
                     calm_best, calm_weight = rep, weight
-        if prefer_calm and calm_best is not None:
-            return calm_best
-        return best
+        chosen = calm_best if (prefer_calm and calm_best is not None) else best
+        if chosen is not None:
+            # Decision audit trail (telemetry/incidents.py): which replica
+            # took this admission and at what weight — ring-complete,
+            # JSONL-throttled (placement is the hottest decision point).
+            record_decision(
+                "route", chosen.name,
+                signals={
+                    "weight": round(
+                        calm_weight if chosen is calm_best else best_weight,
+                        4),
+                    "qos": qos or "-",
+                    "calm_preferred": bool(prefer_calm
+                                           and calm_best is not None),
+                },
+                replica=chosen.name,
+            )
+        return chosen
 
     @staticmethod
     def _burning(replica) -> bool:

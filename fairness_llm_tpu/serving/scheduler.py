@@ -120,6 +120,8 @@ from fairness_llm_tpu.telemetry import (
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
 from fairness_llm_tpu.telemetry.costmodel import instrument_jit, note_invocation
 from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
+from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
+from fairness_llm_tpu.telemetry.incidents import maybe_trigger, record_decision
 from fairness_llm_tpu.telemetry.roofline import observe_decode
 from fairness_llm_tpu.telemetry.timeline import get_timeline
 from fairness_llm_tpu.integrity.numerics import check_finite, masked_finite
@@ -1079,6 +1081,16 @@ class ContinuousScheduler:
             retry_after_s=retry_after,
         )
         count_shed(request.qos, reason, labels=self.labels)
+        # Decision audit trail (telemetry/incidents.py): the refusal with
+        # its inputs — the rung that shed it or the feasibility estimate
+        # that doomed it — keyed to the refused request.
+        record_decision(
+            "shed", reason,
+            signals={"qos": request.qos, "retry_after_s": retry_after,
+                     "level": (self.shed_controller.level
+                               if self.shed_controller is not None else 0)},
+            request_id=request.id, replica=self.replica,
+        )
         if journaled and self.journal is not None:
             self.journal.record_terminal(request.id, "shed")
         if stats is not None:
@@ -1152,6 +1164,38 @@ class ContinuousScheduler:
             queue_wait_s=row.queue_wait_s, ttft_s=row.ttft_s,
         )
         stats.preempted += 1
+
+    def _note_fault(self, stage: str, kind: str, request_ids: List[str],
+                    error) -> None:
+        """One contained fault into the incident engine: a ``fault``
+        decision naming the riders the containment branch just requeued/
+        failed, and — for the kinds with DIRECT evidence of a distinct
+        failure mode — an incident trigger: ``watchdog_hang`` (the step
+        blew its budget) and ``numerics_fault`` (the guard caught a
+        non-finite chunk). Plain device/injected faults stay trigger-free
+        here; a PERSISTENT storm of them opens a breaker, and the breaker
+        transition is that incident's trigger."""
+        record_decision(
+            "fault", f"{stage}:{kind}",
+            signals={"request_ids": list(request_ids),
+                     "error": str(error)[:200]},
+            request_id=(request_ids[0] if request_ids else None),
+            replica=self.replica,
+        )
+        scope = self.replica or "serving"
+        first = request_ids[0] if request_ids else None
+        if kind == "hang":
+            maybe_trigger(
+                "watchdog_hang", f"{stage} step over budget: {error}",
+                scope=scope, replica=self.replica, request_id=first,
+                stage=stage, request_ids=list(request_ids),
+            )
+        elif kind == "numerics":
+            maybe_trigger(
+                "numerics_fault", f"{stage} chunk non-finite: {error}",
+                scope=scope, replica=self.replica, request_id=first,
+                stage=stage, request_ids=list(request_ids),
+            )
 
     def _requeue_or_fail(self, request: Request, error: str,
                          stats: ServingStats, cause: str = "device") -> None:
@@ -1281,6 +1325,10 @@ class ContinuousScheduler:
                 try:
                     self.fault_injector.maybe_fail(req.id, "prefill")
                 except DecodeFault as e:
+                    # Fault decision FIRST, breaker feed second: a trip to
+                    # OPEN dumps an incident bundle, and the bundle's trail
+                    # must already name the request that faulted.
+                    self._note_fault("prefill", "injected", [req.id], e)
                     # Scripted faults feed the breaker like real ones —
                     # that's what makes breaker trips chaos-drillable.
                     if self.breakers is not None:
@@ -1386,6 +1434,9 @@ class ContinuousScheduler:
                 "faults_total", component="serving",
                 kind=kind, stage="prefill", **self.labels,
             ).inc()
+            # Fault decision BEFORE the breaker feed: a trip to OPEN dumps
+            # a bundle whose trail must already name the riders.
+            self._note_fault("prefill", kind, [r.id for r in reqs], e)
             if self.breakers is not None:
                 self.breakers.record_failure("prefill")
             for slot, req in zip(slots, reqs):
@@ -1565,6 +1616,7 @@ class ContinuousScheduler:
                 "faults_total", component="serving",
                 kind=kind, stage="prefill", **self.labels,
             ).inc()
+            self._note_fault("prefill", kind, [r[0].id for r in rows], e)
             if self.breakers is not None:
                 self.breakers.record_failure("prefill")
             for req, ids, slot, plan, real_s in rows:
@@ -1620,6 +1672,7 @@ class ContinuousScheduler:
                 try:
                     self.fault_injector.maybe_fail(req.id, "decode")
                 except DecodeFault as e:
+                    self._note_fault("decode", "injected", [req.id], e)
                     if self.breakers is not None:
                         self.breakers.record_failure("decode")
                     self.pool.release(slot)
@@ -1739,6 +1792,10 @@ class ContinuousScheduler:
                 "faults_total", component="serving",
                 kind=kind, stage="decode", **self.labels,
             ).inc()
+            self._note_fault(
+                "decode", kind,
+                [self.pool.get(s).request.id for s in live_ids], e,
+            )
             if self.breakers is not None:
                 self.breakers.record_failure("decode")
             for slot in live_ids:
@@ -1779,9 +1836,19 @@ class ContinuousScheduler:
         # KV per step (the compiled program does, live rows or not), so
         # batch is num_slots, not len(live_ids).
         dc_wall = now - dc_t0
-        get_timeline().decode_chunk(self._track, dc_t0, dc_wall, steps,
-                                    labels=self.labels, rows=len(live_ids),
-                                    program=step_key[0])
+        gap = get_timeline().decode_chunk(self._track, dc_t0, dc_wall, steps,
+                                          labels=self.labels,
+                                          rows=len(live_ids),
+                                          program=step_key[0])
+        # Flight-recorder chunk ring (telemetry/flightrecorder.py): the
+        # last-K decode chunks with their step gaps — the high-rate recent
+        # history an incident bundle snapshots but nothing persists.
+        get_flight_recorder().record(
+            "chunks", program=step_key[0], steps=steps,
+            wall_s=round(dc_wall, 6),
+            gap_s=(round(gap, 6) if gap is not None else None),
+            rows=len(live_ids), replica=self.replica, t=dc_t0,
+        )
         if first_compile:
             record_compile(
                 step_key[0],

@@ -121,6 +121,28 @@ from fairness_llm_tpu.telemetry.tracing import (
     assert_span_order,
 )
 from fairness_llm_tpu.telemetry.heartbeat import Heartbeat
+from fairness_llm_tpu.telemetry.flightrecorder import (
+    FlightRecorder,
+    get_flight_recorder,
+    recording_on,
+    set_flight_recorder,
+    set_recording,
+    use_flight_recorder,
+)
+from fairness_llm_tpu.telemetry.incidents import (
+    DecisionRecord,
+    IncidentManager,
+    arm_incidents,
+    causal_chain,
+    get_incident_manager,
+    list_bundles,
+    maybe_trigger,
+    record_decision,
+    render_incident_report,
+    set_incident_manager,
+    use_incident_manager,
+    validate_incidents,
+)
 
 # -- process-wide event sink --------------------------------------------------
 # One sink per process, installed by the CLI when --telemetry-dir is set
@@ -147,17 +169,26 @@ def emit_event(kind: str, **fields) -> None:
         _event_sink.emit(kind, **fields)
 
 
-def configure(telemetry_dir: str) -> JsonlSink:
+def configure(telemetry_dir: str,
+              events_max_bytes: Optional[int] = None) -> JsonlSink:
     """Stand up the exporters for a run: mkdir the telemetry dir and install
-    the JSONL event sink there. Snapshot writing stays explicit
-    (``write_snapshot`` at end of run) — a snapshot mid-run is valid too,
-    it just reflects less."""
+    the JSONL event sink there, size-rotated (``events.jsonl.1..N`` kept;
+    see export.py — a million-user replay must not grow one file forever).
+    Snapshot writing stays explicit (``write_snapshot`` at end of run) — a
+    snapshot mid-run is valid too, it just reflects less."""
     import os
 
-    from fairness_llm_tpu.telemetry.export import EVENTS_FILENAME
+    from fairness_llm_tpu.telemetry.export import (
+        EVENTS_FILENAME,
+        EVENTS_MAX_BYTES,
+    )
 
     os.makedirs(telemetry_dir, exist_ok=True)
-    sink = JsonlSink(os.path.join(telemetry_dir, EVENTS_FILENAME))
+    sink = JsonlSink(
+        os.path.join(telemetry_dir, EVENTS_FILENAME),
+        max_bytes=(events_max_bytes if events_max_bytes is not None
+                   else EVENTS_MAX_BYTES),
+    )
     install_event_sink(sink)
     return sink
 
@@ -229,4 +260,22 @@ __all__ = [
     "get_slo_targets",
     "set_slo_targets",
     "render_slo_report",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "set_flight_recorder",
+    "use_flight_recorder",
+    "recording_on",
+    "set_recording",
+    "DecisionRecord",
+    "IncidentManager",
+    "arm_incidents",
+    "causal_chain",
+    "get_incident_manager",
+    "set_incident_manager",
+    "use_incident_manager",
+    "list_bundles",
+    "maybe_trigger",
+    "record_decision",
+    "render_incident_report",
+    "validate_incidents",
 ]

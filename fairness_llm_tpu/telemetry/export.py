@@ -39,13 +39,38 @@ PROM_FILENAME = "metrics.prom"
 EVENTS_FILENAME = "events.jsonl"
 
 
+# Size-based rotation defaults for the process sink (telemetry.configure):
+# a million-user replay writes every lifecycle event forever, and an
+# unbounded events.jsonl eventually fills the disk that also holds the
+# journal. Rotation keeps the newest EVENTS_MAX_BYTES per file and
+# EVENTS_KEEP rotated generations (events.jsonl.1 newest ... .N oldest);
+# everything older is gone — the aggregate truth stays in the registry.
+EVENTS_MAX_BYTES = 128 * 1024 * 1024
+EVENTS_KEEP = 3
+
+
 class JsonlSink:
     """Append-only JSONL event writer. Line-buffered-ish: flushed per emit —
     event volume is per-request/per-heartbeat (not per-token), so durability
-    beats write batching here."""
+    beats write batching here.
 
-    def __init__(self, path: str):
+    ``max_bytes`` arms size-based rotation: when the live file crosses the
+    bound after an emit, it rotates to ``<path>.1`` (existing generations
+    shift up, the oldest beyond ``keep`` is deleted) and a fresh live file
+    opens. Rotation happens BETWEEN emits, so every generation holds whole
+    lines except possibly a torn final one from a kill — which the readers
+    already tolerate."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 keep: int = EVENTS_KEEP):
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.path = path
+        self.max_bytes = max_bytes
+        self.keep = keep
+        self.rotations = 0
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
 
@@ -53,6 +78,21 @@ class JsonlSink:
         rec = {"ts_unix": time.time(), "kind": kind, **fields}
         self._f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._f.flush()
+        if self.max_bytes is not None and self._f.tell() >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        oldest = f"{self.path}.{self.keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.keep - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.rotations += 1
 
     def close(self) -> None:
         if not self._f.closed:
@@ -66,18 +106,37 @@ class JsonlSink:
 
 
 def read_events(path: str) -> List[Dict]:
-    """Load an ``events.jsonl`` back (skipping any torn final line — the
-    sink flushes per event, but a killed process can still leave one)."""
+    """Load an ``events.jsonl`` back — INCLUDING rotated generations
+    (``<path>.N`` oldest-first, then the live file), skipping any torn
+    line: the sink flushes per event, but a killed process (or a kill
+    mid-rotation) can still leave one, in any generation."""
+    # Discover generations by listing, not by counting up from .1: a kill
+    # BETWEEN _rotate's two renames leaves .2 present with .1 absent, and
+    # a sequential probe would silently drop everything past the gap.
+    base = os.path.basename(path)
+    parent = os.path.dirname(os.path.abspath(path))
+    gens: List[int] = []
+    if os.path.isdir(parent):
+        for name in os.listdir(parent):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    gens.append(int(suffix))
+    # Largest N is oldest — read it first, the live file last.
+    paths: List[str] = [f"{path}.{g}" for g in sorted(gens, reverse=True)]
+    if os.path.exists(path) or not paths:
+        paths.append(path)
     out: List[Dict] = []
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
+    for p in paths:
+        with open(p, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
     return out
 
 

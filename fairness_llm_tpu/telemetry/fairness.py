@@ -517,6 +517,19 @@ class FairnessMonitor:
         from fairness_llm_tpu.telemetry import emit_event  # lazy: no cycle
 
         emit_event("fairness_pair_divergent", **record)
+        # Incident engine (telemetry/incidents.py): a divergent
+        # counterfactual pair is the paper's audit claim failing LIVE —
+        # bundle the serving evidence (which replica, which requeues)
+        # while the flight recorder still holds it. Deduped per attribute:
+        # a biased-fault storm produces one bundle, with every divergent
+        # pair already in the decision trail.
+        from fairness_llm_tpu.telemetry.incidents import maybe_trigger
+
+        maybe_trigger(
+            "pair_divergence",
+            f"counterfactual pair {ps.pair_id} diverged ({cause})",
+            scope=ps.attribute, pair_id=ps.pair_id, divergence_cause=cause,
+        )
 
     # -- derived gauges ------------------------------------------------------
 
@@ -647,6 +660,14 @@ class FairnessMonitor:
             emit_event("fairness_alert", attribute=attr, signal=signal,
                        disparity=round(gap, 4),
                        threshold=self.disparity_threshold)
+            from fairness_llm_tpu.telemetry.incidents import maybe_trigger
+
+            maybe_trigger(
+                "fairness_alert",
+                f"neutrality audit: {signal} disparity {gap:.3f} > "
+                f"{self.disparity_threshold:g} on attribute {attr!r}",
+                scope=attr, signal=signal, disparity=round(gap, 4),
+            )
         elif gap <= self.disparity_threshold and was:
             self._alerting[key] = False
             emit_event("fairness_resolved", attribute=attr, signal=signal,

@@ -38,6 +38,13 @@ logger = logging.getLogger(__name__)
 # normal cadence lands just past 1.0x).
 GAP_FACTOR = 1.5
 
+# A gap past this many intervals is a SUSTAINED dark period — routed
+# through the incident trigger registry (telemetry/incidents.py), so a
+# wedged process leaves a postmortem bundle behind instead of only a
+# gauge. 4x: two whole missed beats beyond the ordinary-gap threshold —
+# co-tenant jitter recovers inside one interval; a wedge doesn't.
+INCIDENT_GAP_FACTOR = 4.0
+
 
 class Heartbeat:
     def __init__(self, interval_s: float = 30.0, name: str = "sweep",
@@ -73,6 +80,23 @@ class Heartbeat:
                           component=self.name).set_max(since)
                 emit_event("heartbeat_gap", name=self.name,
                            gap_s=round(since, 2))
+                from fairness_llm_tpu.telemetry.incidents import (
+                    maybe_trigger,
+                    record_decision,
+                )
+
+                record_decision(
+                    "heartbeat", "gap",
+                    signals={"name": self.name, "gap_s": round(since, 2),
+                             "interval_s": self.interval_s},
+                )
+                if since > INCIDENT_GAP_FACTOR * self.interval_s:
+                    maybe_trigger(
+                        "heartbeat_gap",
+                        f"{self.name} went dark {since:.1f}s "
+                        f"(interval {self.interval_s:g}s)",
+                        scope=self.name, gap_s=round(since, 2),
+                    )
         self._last_beat = now
         self.beats += 1
         uptime = now - self.started_at

@@ -148,5 +148,17 @@ def observe_decode(config, stats: Dict, steps: int, wall_s: float,
                   program=program, **lbl).observe(gbps)
     reg.gauge("achieved_over_achievable", component="roofline",
               program=program, **lbl).set(frac)
+    # Flight-recorder roofline ring (telemetry/flightrecorder.py): the
+    # last-K per-chunk samples — a bundle shows whether bandwidth was
+    # degrading INTO the incident, which the last-write gauge cannot.
+    from fairness_llm_tpu.telemetry.flightrecorder import (  # lazy: no cycle
+        get_flight_recorder,
+    )
+
+    get_flight_recorder().record(
+        "roofline", program=program, steps=steps,
+        gbps=round(gbps, 3), fraction=round(frac, 4),
+        replica=lbl.get("replica"),
+    )
     return {"step_bytes": step_bytes, "gbps": gbps,
             "achievable_gbps": achievable, "fraction": frac}

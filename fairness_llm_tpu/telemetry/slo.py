@@ -221,6 +221,32 @@ class SLOEvaluator:
             emit_event("slo_alert", slo=slo, window=window,
                        burn_rate=round(burn, 3), component=self.component,
                        **self.labels)
+            # Incident engine (telemetry/incidents.py): the alert as a
+            # decision, and — for the ERROR-budget objective only — a
+            # bundle trigger. Latency burns (TTFT/e2e) alert legitimately
+            # in fault-free batch sweeps (a compile-heavy first chunk
+            # blows the TTFT target on the CPU harness), so bundling them
+            # would break the fault-free-runs-produce-zero-bundles
+            # contract; an error burn means requests actually failed.
+            from fairness_llm_tpu.telemetry.incidents import (
+                maybe_trigger,
+                record_decision,
+            )
+
+            record_decision(
+                "slo_alert", f"{slo}:{window}",
+                signals={"burn_rate": round(burn, 3)},
+                replica=self.labels.get("replica"),
+            )
+            if slo == "error_rate":
+                maybe_trigger(
+                    "slo_burn",
+                    f"error-rate burn {burn:.2f} over the {window} window",
+                    scope=(self.labels.get("replica")
+                           or self.labels.get("fleet") or self.component),
+                    replica=self.labels.get("replica"),
+                    window=window, burn_rate=round(burn, 3),
+                )
         elif burn <= 1.0 and was:
             self._alerting[key] = False
             emit_event("slo_resolved", slo=slo, window=window,

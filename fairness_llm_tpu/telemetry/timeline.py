@@ -125,16 +125,19 @@ class Timeline:
 
     def decode_chunk(self, track: str, t0: float, dur_s: float, steps: int,
                      labels: Optional[Dict[str, str]] = None,
-                     program: Optional[str] = None, **args) -> None:
+                     program: Optional[str] = None,
+                     **args) -> Optional[float]:
         """A decode-chunk span, plus the step-gap accounting: the time from
         the previous chunk's end (same track) to this chunk's start is
         host-side sync/admission work the device spent idle — observed into
         the ``step_gap_s`` histogram and stamped onto the span. With
         ``program`` set, the gap ALSO accumulates into the per-program
         ``cost_host_gap_s_total`` gauge — the MEASURED host-gap term of the
-        cost-ledger gap decomposition (telemetry/costmodel.py)."""
+        cost-ledger gap decomposition (telemetry/costmodel.py). Returns the
+        gap (None for the track's first chunk, or when gated off) so the
+        caller can stamp it onto its flight-recorder ring entry."""
         if not self.enabled:
-            return
+            return None
         gap = None
         last_end = self._last_chunk_end.get(track)
         if last_end is not None:
@@ -166,6 +169,7 @@ class Timeline:
             args = {**args, "program": program}
         self.record_span(f"decode_chunk[{steps}]", "decode", track, t0,
                          dur_s, steps=steps, **args)
+        return gap
 
     def note_busy(self, track: str, t0: float, dur_s: float) -> None:
         """Mark ``[t0, t0+dur_s)`` as device-busy on ``track`` (a prefill

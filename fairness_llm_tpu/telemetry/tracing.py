@@ -131,6 +131,17 @@ class RequestTracer:
 
         emit_event("span", request_id=request_id, event=event, t=ev.t,
                    component=self.component, **self.labels)
+        # Flight-recorder lifecycle ring (telemetry/flightrecorder.py): the
+        # recent request edges an incident bundle snapshots — which
+        # requests were in flight, and where, when the trigger fired.
+        from fairness_llm_tpu.telemetry.flightrecorder import (  # lazy: cycle
+            get_flight_recorder,
+        )
+
+        get_flight_recorder().record(
+            "lifecycle", request_id=request_id, event=event, t=ev.t,
+            replica=self.labels.get("replica"),
+        )
         # Timeline bridge: every lifecycle edge is an instant on this
         # scheduler's request lane — admissions/evictions/requeues/fences
         # read directly off the Perfetto timeline, on the right replica

@@ -1,0 +1,783 @@
+"""Incident engine tests: flight recorder, decision trail, triggers,
+bundles, and the rotated JSONL sink.
+
+The load-bearing guarantees pinned here (ISSUE 13):
+
+- flight-recorder rings are BOUNDED: a flood evicts oldest, counts drops,
+  and never grows; gauge transitions dedup on value;
+- every decision point emits a schema-complete ``DecisionRecord`` (known
+  decision kind, string action, dict signals, timestamp) — exercised
+  through the real components (breaker, ladder, shed controller, router,
+  autoscaler, heartbeat, SLO alerts) and through a real scheduler's
+  fault/shed paths;
+- trigger dedup/cooldown: inside the cooldown the same (class, scope)
+  suppresses (counted), a different scope or an elapsed cooldown dumps —
+  with an injectable clock, no sleeps;
+- bundle dumps are ATOMIC: a dump that dies mid-write leaves no final
+  bundle dir and no ``.partial`` leftover, and is counted, never raised
+  into the serving loop;
+- attribution off (and recording off) records NOTHING — rings, decisions,
+  counters all silent;
+- ``validate_incidents`` accepts a complete bundle set (``require``),
+  rejects empties/torn bundles, and ``forbid`` rejects any bundle;
+- ``render_incident_report`` derives the causal chain from the recorded
+  trail ("fence(r1) <- 3x breaker trips <- numerics faults <- requests");
+- the JSONL sink rotates on size with torn-tail-tolerant readers, and the
+  ``fairness-report``/``slo-report`` CLI paths still read rotated dirs.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+import fairness_llm_tpu.telemetry as T
+from fairness_llm_tpu.telemetry import (
+    use_flight_recorder,
+    use_incident_manager,
+    use_registry,
+    use_timeline,
+)
+from fairness_llm_tpu.telemetry.flightrecorder import (
+    RING_CATEGORIES,
+    FlightRecorder,
+    set_recording,
+)
+from fairness_llm_tpu.telemetry.incidents import (
+    DECISIONS,
+    INCIDENT_CLASSES,
+    IncidentManager,
+    record_decision,
+    validate_incidents,
+)
+
+
+def _tool(name):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import importlib
+
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def _decisions(rec):
+    return list(rec.rings["decisions"])
+
+
+def _assert_schema(entry):
+    assert entry["decision"] in DECISIONS
+    assert isinstance(entry["action"], str) and entry["action"]
+    assert isinstance(entry["signals"], dict)
+    assert isinstance(entry["t"], float)
+
+
+# -- flight recorder -----------------------------------------------------------
+
+
+def test_ring_bounded_eviction_under_flood():
+    with use_registry(), use_timeline():
+        rec = FlightRecorder(capacity=8)
+        with use_flight_recorder(rec):
+            for i in range(100):
+                assert rec.record("chunks", i=i)
+            assert len(rec.rings["chunks"]) == 8
+            assert rec.dropped["chunks"] == 92
+            # Oldest evicted: the survivors are the newest 8.
+            assert [e["i"] for e in rec.rings["chunks"]] == list(range(92, 100))
+
+
+def test_ring_unknown_category_is_noop():
+    rec = FlightRecorder(capacity=4)
+    assert not rec.record("no_such_ring", x=1)
+
+
+def test_transition_dedup_on_value():
+    with use_registry(), use_timeline():
+        rec = FlightRecorder(capacity=8)
+        with use_flight_recorder(rec):
+            assert rec.transition("breaker_state", "serving/decode", "open")
+            assert not rec.transition("breaker_state", "serving/decode",
+                                      "open")
+            assert rec.transition("breaker_state", "serving/decode", "closed")
+            assert rec.transition("breaker_state", "serving/prefill", "open")
+            edges = list(rec.rings["transitions"])
+            assert len(edges) == 3
+            assert edges[0]["prev"] is None
+            assert edges[1]["prev"] == "open"
+
+
+def test_snapshot_shape():
+    with use_registry(), use_timeline():
+        rec = FlightRecorder(capacity=4)
+        with use_flight_recorder(rec):
+            rec.record("lifecycle", request_id="a", event="submitted")
+            snap = rec.snapshot()
+    assert set(snap["rings"]) == set(RING_CATEGORIES)
+    assert snap["rings"]["lifecycle"][0]["request_id"] == "a"
+    assert snap["capacity"] == 4
+
+
+# -- attribution / recording gating -------------------------------------------
+
+
+def test_attribution_off_records_nothing():
+    from fairness_llm_tpu.telemetry import set_attribution
+
+    with use_registry() as reg, use_timeline():
+        rec = FlightRecorder(capacity=8)
+        with use_flight_recorder(rec):
+            prev = set_attribution(False)
+            try:
+                assert not rec.record("chunks", x=1)
+                assert not rec.transition("g", "k", 1)
+                assert record_decision("route", "r0") is None
+            finally:
+                set_attribution(prev)
+            assert all(not v for v in rec.rings.values())
+            assert reg.peek("decisions_total", component="incidents") is None
+
+
+def test_recording_switch_off_records_nothing():
+    with use_registry() as reg, use_timeline():
+        rec = FlightRecorder(capacity=8)
+        with use_flight_recorder(rec):
+            prev = set_recording(False)
+            try:
+                assert not rec.record("chunks", x=1)
+                assert record_decision("route", "r0") is None
+            finally:
+                set_recording(prev)
+            assert all(not v for v in rec.rings.values())
+            assert reg.peek("decisions_total", component="incidents") is None
+
+
+def test_record_decision_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        record_decision("not_a_decision", "x")
+
+
+# -- decision points (unit: the real components) -------------------------------
+
+
+def test_breaker_and_ladder_decisions():
+    from fairness_llm_tpu.resilience.breaker import BreakerBoard
+
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, use_incident_manager():
+            board = BreakerBoard(failure_threshold=1, cooldown_s=60.0)
+            board.record_failure("decode")
+            kinds = {(d["decision"], d["action"]) for d in _decisions(rec)}
+            assert ("breaker", "decode:closed->open") in kinds
+            assert ("ladder", "0->1") in kinds
+            for d in _decisions(rec):
+                _assert_schema(d)
+            # The gauge edges landed in the transitions ring too.
+            names = {e["name"] for e in rec.rings["transitions"]}
+            assert {"breaker_state", "degradation_level"} <= names
+
+
+def test_shed_controller_transition_decision():
+    from fairness_llm_tpu.config import OverloadConfig
+    from fairness_llm_tpu.serving.overload import ShedController
+
+    clock = [0.0]
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, use_incident_manager():
+            ctl = ShedController(
+                OverloadConfig(enabled=True, queue_frac_threshold=0.5,
+                               eval_interval_s=0.0),
+                clock=lambda: clock[0],
+            )
+            ctl.observe_queue_depth(10, 10)
+            ctl.evaluate()
+            ds = [d for d in _decisions(rec) if d["decision"] == "overload"]
+            assert len(ds) == 1 and ds[0]["action"] == "0->1"
+            assert ds[0]["signals"]["rung"] == "shed_batch"
+            assert "queue_frac" in ds[0]["signals"]
+            _assert_schema(ds[0])
+
+
+class _FakeQueue:
+    closed = False
+    full = False
+
+    def __len__(self):
+        return 0
+
+
+class _FakeSched:
+    def __init__(self):
+        self.queue = _FakeQueue()
+        self.breakers = None
+        self.watchdog = None
+        self.has_work = False
+        self._pending = []
+        self.num_slots = 4
+
+        class _Pool:
+            occupancy = 0
+
+        self.pool = _Pool()
+
+
+class _FakeReplica:
+    def __init__(self, name):
+        self.name = name
+        self.fenced = False
+        self.sched = _FakeSched()
+
+
+def test_router_pick_decision_and_health_edges():
+    from fairness_llm_tpu.serving.router import HealthRouter
+
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, use_incident_manager():
+            router = HealthRouter()
+            reps = [_FakeReplica("r0"), _FakeReplica("r1")]
+            chosen = router.pick(reps)
+            assert chosen is not None
+            # Placement decisions land in their OWN ring — a routing flood
+            # must never evict the rare criticals from the decisions ring.
+            assert not _decisions(rec)
+            ds = [d for d in rec.rings["routes"]
+                  if d["decision"] == "route"]
+            assert len(ds) == 1 and ds[0]["action"] == chosen.name
+            assert ds[0]["replica"] == chosen.name
+            assert "weight" in ds[0]["signals"]
+            _assert_schema(ds[0])
+            edges = [e for e in rec.rings["transitions"]
+                     if e["name"] == "replica_health_score"]
+            assert {e["key"] for e in edges} == {"r0", "r1"}
+
+
+class _FakeFleet:
+    """The Autoscaler's duck-typed surface (see its __init__ docstring)."""
+
+    def __init__(self):
+        self.replicas = [_FakeReplica("r0")]
+        self.queue = []
+        self._pending = []
+        self._fleet_labels = {}
+        self.shed_controller = None
+
+        class _Serving:
+            queue_capacity = 16
+
+        self.serving = _Serving()
+
+        class _Router:
+            @staticmethod
+            def load(rep):
+                return 0.0
+
+        self.router = _Router()
+
+    def _max_replica_burn(self):
+        return 9.0  # permanently hot: every tick wants a scale-up
+
+    def add_replica(self):
+        rep = _FakeReplica(f"r{len(self.replicas)}")
+        self.replicas.append(rep)
+        return rep
+
+    def retire_replica(self, rep):
+        self.replicas.remove(rep)
+        return 0
+
+
+def test_autoscaler_decision():
+    from fairness_llm_tpu.config import AutoscaleConfig
+    from fairness_llm_tpu.serving.autoscaler import Autoscaler
+
+    clock = [0.0]
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, use_incident_manager():
+            fleet = _FakeFleet()
+            ctl = Autoscaler(fleet, AutoscaleConfig(
+                enabled=True, min_replicas=1, max_replicas=3,
+                up_window_s=1.0, cooldown_s=0.0, eval_interval_s=0.0,
+            ), clock=lambda: clock[0])
+            ctl.tick()        # hot window starts
+            clock[0] = 2.0
+            assert ctl.tick() == "up"
+            ds = [d for d in _decisions(rec) if d["decision"] == "autoscale"]
+            assert len(ds) == 1 and ds[0]["action"] == "up"
+            assert ds[0]["signals"]["burn"] == 9.0
+            _assert_schema(ds[0])
+            edges = [e for e in rec.rings["transitions"]
+                     if e["name"] == "fleet_replicas"]
+            assert edges and edges[-1]["value"] == 2
+
+
+def test_heartbeat_gap_decision_and_trigger(tmp_path):
+    from fairness_llm_tpu.telemetry.heartbeat import Heartbeat
+
+    clock = [0.0]
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, \
+                use_incident_manager() as mgr:
+            mgr.arm(str(tmp_path / "incidents"))
+            hb = Heartbeat(interval_s=10.0, name="t", clock=lambda: clock[0])
+            hb.poke()
+            clock[0] = 12.0
+            hb.poke()  # ordinary cadence: no gap
+            clock[0] = 100.0
+            hb.poke()  # 88 s dark: gap AND sustained (> 4x interval)
+            ds = [d for d in _decisions(rec) if d["decision"] == "heartbeat"]
+            assert len(ds) == 1 and ds[0]["signals"]["gap_s"] == 88.0
+            bundles = T.list_bundles(str(tmp_path / "incidents"))
+            assert len(bundles) == 1
+            assert bundles[0]["class"] == "heartbeat_gap"
+            assert "went dark" in bundles[0]["cause"]
+
+
+def test_slo_error_alert_triggers_bundle(tmp_path):
+    from fairness_llm_tpu.telemetry.slo import SLOEvaluator, SLOTargets
+
+    with use_registry(), use_timeline():
+        with use_flight_recorder() as rec, \
+                use_incident_manager() as mgr:
+            mgr.arm(str(tmp_path / "incidents"))
+            ev = SLOEvaluator(targets=SLOTargets(error_rate=0.01))
+            ev.observe("failed", ttft_s=None, e2e_s=None)
+            ds = [d for d in _decisions(rec) if d["decision"] == "slo_alert"]
+            assert ds and all(d["action"].startswith("error_rate")
+                              for d in ds)
+            bundles = T.list_bundles(str(tmp_path / "incidents"))
+            # One slo_burn bundle (scope-deduped across the three windows).
+            assert [b["class"] for b in bundles] == ["slo_burn"]
+
+
+def test_slo_latency_alert_does_not_trigger(tmp_path):
+    from fairness_llm_tpu.telemetry.slo import SLOEvaluator, SLOTargets
+
+    with use_registry(), use_timeline():
+        with use_flight_recorder(), use_incident_manager() as mgr:
+            mgr.arm(str(tmp_path / "incidents"))
+            ev = SLOEvaluator(targets=SLOTargets(ttft_p95_s=0.001))
+            ev.observe("completed", ttft_s=5.0, e2e_s=5.0)
+            # TTFT burns alert (gauges/events) but must NOT bundle — a
+            # fault-free batch sweep blows TTFT on compile alone.
+            assert T.list_bundles(str(tmp_path / "incidents")) == []
+
+
+# -- trigger dedup / cooldown --------------------------------------------------
+
+
+def test_trigger_dedup_cooldown_injectable_clock(tmp_path):
+    clock = [0.0]
+    with use_registry() as reg, use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(str(tmp_path), cooldown_s=60.0,
+                              clock=lambda: clock[0])
+        p1 = mgr.trigger("breaker_open", "first", scope="serving")
+        assert p1 is not None and os.path.isdir(p1)
+        # Same (class, scope) inside the cooldown: suppressed, counted.
+        assert mgr.trigger("breaker_open", "again", scope="serving") is None
+        assert reg.read_value("incident_suppressed_total",
+                              component="incidents",
+                              **{"class": "breaker_open"}) == 1
+        # Different scope: its own dedup key, dumps immediately.
+        p2 = mgr.trigger("breaker_open", "other replica", scope="r1")
+        assert p2 is not None and p2 != p1
+        # Cooldown elapsed: dumps again.
+        clock[0] = 61.0
+        p3 = mgr.trigger("breaker_open", "third", scope="serving")
+        assert p3 is not None and p3 not in (p1, p2)
+        assert reg.read_value("incident_triggers_total",
+                              component="incidents",
+                              **{"class": "breaker_open"}) == 4
+        assert reg.read_value("incident_bundles_total",
+                              component="incidents",
+                              **{"class": "breaker_open"}) == 3
+
+
+def test_route_flood_cannot_evict_critical_decisions():
+    with use_registry(), use_timeline():
+        rec = FlightRecorder(capacity=8)
+        with use_flight_recorder(rec):
+            record_decision("breaker", "decode:closed->open")
+            for i in range(100):
+                record_decision("route", f"r{i % 2}")
+            # The breaker decision survived the flood; routes have their
+            # own (bounded) ring.
+            assert [d["decision"] for d in _decisions(rec)] == ["breaker"]
+            assert len(rec.rings["routes"]) == 8
+
+
+def test_rearm_into_existing_dir_never_collides(tmp_path):
+    with use_registry(), use_timeline(), use_flight_recorder():
+        m1 = IncidentManager(str(tmp_path))
+        p1 = m1.trigger("fence", "first run", scope="r0")
+        # A fresh manager (new process) over the SAME dir: its seq restarts
+        # but names must skip past the prior run's bundles.
+        m2 = IncidentManager(str(tmp_path))
+        p2 = m2.trigger("fence", "second run", scope="r0")
+        assert p2 is not None and p2 != p1
+        assert len(T.list_bundles(str(tmp_path))) == 2
+
+
+def test_failed_dump_does_not_stamp_cooldown(tmp_path, monkeypatch):
+    with use_registry(), use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(str(tmp_path), cooldown_s=3600.0)
+        orig = IncidentManager._write_json
+
+        def dying(dir_, name, obj):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(IncidentManager, "_write_json",
+                            staticmethod(dying))
+        assert mgr.trigger("fence", "x", scope="r0") is None
+        monkeypatch.setattr(IncidentManager, "_write_json",
+                            staticmethod(orig))
+        # The failure must NOT have started the cooldown: the next trigger
+        # of the same (class, scope) dumps instead of suppressing for an
+        # hour with nothing on disk.
+        assert mgr.trigger("fence", "y", scope="r0") is not None
+
+
+def test_forbid_flags_partial_leftover(tmp_path):
+    tel = tmp_path / "tel"
+    inc = tel / "incidents"
+    os.makedirs(str(inc / "fence-r0-001.partial"))
+    assert any("fired" in p
+               for p in validate_incidents(str(tel), forbid=True))
+
+
+def test_trigger_disarmed_is_noop(tmp_path):
+    with use_registry() as reg, use_timeline(), use_flight_recorder():
+        mgr = IncidentManager()  # no dir = disarmed
+        assert mgr.trigger("fence", "x", scope="r0") is None
+        assert reg.peek("incident_triggers_total",
+                        component="incidents") is None
+
+
+def test_trigger_unknown_class_rejected(tmp_path):
+    mgr = IncidentManager(str(tmp_path))
+    with pytest.raises(ValueError):
+        mgr.trigger("not_a_class", "x")
+    assert set(INCIDENT_CLASSES) >= {"breaker_open", "fence",
+                                     "watchdog_hang", "numerics_fault",
+                                     "canary_mismatch", "heartbeat_gap"}
+
+
+# -- bundle contents / atomicity -----------------------------------------------
+
+
+def test_bundle_contents_and_implicated_filter(tmp_path):
+    with use_registry(), use_timeline(), use_flight_recorder() as rec:
+        record_decision("fault", "decode:numerics",
+                        signals={"request_ids": ["a", "b"]},
+                        request_id="a", replica="r1")
+        record_decision("route", "r0", replica="r0")
+        mgr = IncidentManager(str(tmp_path))
+        path = mgr.trigger("numerics_fault", "nan chunk", scope="r1",
+                           replica="r1", request_id="a")
+        assert path is not None
+        for fn in ("incident.json", "flightrecorder.json", "decisions.jsonl",
+                   "decisions_implicated.jsonl", "snapshot.json",
+                   "trace_slice.json"):
+            assert os.path.isfile(os.path.join(path, fn)), fn
+        with open(os.path.join(path, "incident.json")) as f:
+            manifest = json.load(f)
+        assert manifest["class"] == "numerics_fault"
+        assert manifest["replica"] == "r1"
+        assert manifest["ring_depths"]["decisions"] >= 2
+        # The implicated trail filters to r1/a: the r0 route stays out.
+        with open(os.path.join(path, "decisions_implicated.jsonl")) as f:
+            imp = [json.loads(line) for line in f if line.strip()]
+        assert imp and all(d.get("replica") == "r1"
+                           or d.get("request_id") == "a" for d in imp)
+        # The ring snapshot inside the bundle holds the decision trail too.
+        with open(os.path.join(path, "flightrecorder.json")) as f:
+            fr = json.load(f)
+        assert len(fr["rings"]["decisions"]) == len(rec.rings["decisions"])
+
+
+def test_bundle_atomicity_mid_dump_kill(tmp_path, monkeypatch):
+    with use_registry() as reg, use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(str(tmp_path))
+        orig = IncidentManager._write_json
+
+        def dying(dir_, name, obj):
+            if name == "snapshot.json":
+                raise OSError("disk died mid-dump")
+            orig(dir_, name, obj)
+
+        monkeypatch.setattr(IncidentManager, "_write_json",
+                            staticmethod(dying))
+        # Contained: returns None, never raises into the caller.
+        assert mgr.trigger("fence", "x", scope="r0") is None
+        # No final bundle, no .partial leftover — nothing torn.
+        assert os.listdir(str(tmp_path)) == []
+        assert reg.read_value("incident_dump_failures_total",
+                              component="incidents") == 1
+        # The manager recovers: the next (post-cooldown) dump succeeds.
+        monkeypatch.setattr(IncidentManager, "_write_json",
+                            staticmethod(orig))
+        mgr._last_dump.clear()
+        assert mgr.trigger("fence", "y", scope="r0") is not None
+
+
+# -- validate_incidents (--require / --forbid) ---------------------------------
+
+
+def test_validate_incidents_accept_reject(tmp_path):
+    tel = tmp_path / "tel"
+    inc = tel / "incidents"
+    with use_registry(), use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(str(inc))
+        # Empty: require rejects, forbid accepts.
+        os.makedirs(str(inc))
+        assert validate_incidents(str(tel), require=True)
+        assert validate_incidents(str(tel), forbid=True) == []
+        # One good bundle: require accepts, forbid rejects.
+        record_decision("fence", "replica_crash", replica="r1")
+        mgr.trigger("fence", "replica r1 fenced", scope="r1", replica="r1")
+        assert validate_incidents(str(tel), require=True) == []
+        assert validate_incidents(str(tel), forbid=True)
+        # A torn .partial leftover: require rejects.
+        os.makedirs(str(inc / "fence-zz-099.partial"))
+        assert any("torn" in p
+                   for p in validate_incidents(str(tel), require=True))
+        os.rmdir(str(inc / "fence-zz-099.partial"))
+        # A bundle missing a required file: require rejects.
+        bundle = T.list_bundles(str(inc))[0]["path"]
+        os.remove(os.path.join(bundle, "snapshot.json"))
+        assert any("snapshot.json" in p
+                   for p in validate_incidents(str(tel), require=True))
+
+
+def test_validate_telemetry_tool_gates(tmp_path):
+    vt = _tool("validate_telemetry")
+    tel = str(tmp_path / "tel")
+    with use_registry() as reg, use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(os.path.join(tel, "incidents"))
+        record_decision("fence", "replica_crash", replica="r1")
+        mgr.trigger("fence", "replica r1 fenced", scope="r1", replica="r1")
+        T.write_snapshot(reg, tel)
+        assert vt.check(tel, require_incidents=True) == 0
+        assert vt.check(tel, forbid_incidents=True) == 1
+    # A fresh registry (zero decisions/bundle counters) must fail require:
+    # the snapshot cross-checks bite, not just the files.
+    with use_registry() as reg2, use_timeline(), use_flight_recorder():
+        T.write_snapshot(reg2, tel)
+        assert vt.check(tel, require_incidents=True) == 1
+
+
+# -- report rendering ----------------------------------------------------------
+
+
+def test_report_renders_synthetic_fence_chain(tmp_path):
+    with use_registry(), use_timeline(), use_flight_recorder():
+        for _ in range(3):
+            record_decision("breaker", "decode:closed->open",
+                            signals={"consecutive_failures": 1,
+                                     "stage": "decode"},
+                            replica="r1")
+        record_decision("fault", "decode:numerics",
+                        signals={"request_ids": ["a", "b", "c"]},
+                        request_id="a", replica="r1")
+        record_decision("fence", "breakers",
+                        signals={"open_breakers": 1}, replica="r1")
+        mgr = IncidentManager(str(tmp_path))
+        path = mgr.trigger("fence", "replica r1 fenced: breakers",
+                           scope="r1", replica="r1")
+        report = T.render_incident_report(path)
+        chain = next(ln for ln in report.splitlines()
+                     if ln.strip().startswith("fence("))
+        assert "fence(r1)" in chain
+        assert "3x breaker:decode:closed->open" in chain
+        assert "requests a, b, c" in chain
+        # The table view names the fence decision too.
+        assert "decision trail" in report and "fence" in report
+
+
+# -- integration: scheduler fault/shed decision points -------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+def _greedy(m):
+    from fairness_llm_tpu.config import ModelSettings
+
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+def test_scheduler_fault_decision_and_breaker_bundle(engine, tmp_path):
+    from fairness_llm_tpu.config import ResilienceConfig, ServingConfig
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+    from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+    with use_registry(), use_timeline(), use_flight_recorder() as rec, \
+            use_incident_manager() as mgr:
+        mgr.arm(str(tmp_path / "incidents"), cooldown_s=3600.0)
+        inj = ScriptedFaultInjector(faults={("bad", "decode"): 1})
+        sched = ContinuousScheduler(
+            engine,
+            ServingConfig(enabled=True, num_slots=2, max_new_tokens=8),
+            settings=_greedy(8), fault_injector=inj,
+            resilience=ResilienceConfig(enabled=True, breaker_threshold=1,
+                                        breaker_cooldown_s=0.01),
+        )
+        results = sched.serve([Request(prompt="hello there", id="bad",
+                                       settings=_greedy(8))])
+        assert results[0].ok  # requeue-once healed it
+        ds = [d for d in _decisions(rec) if d["decision"] == "fault"]
+        assert ds and ds[0]["action"] == "decode:injected"
+        assert ds[0]["signals"]["request_ids"] == ["bad"]
+        _assert_schema(ds[0])
+        bundles = T.list_bundles(str(tmp_path / "incidents"))
+        assert [b["class"] for b in bundles] == ["breaker_open"]
+        # The bundle's trail names the injected request — the "decision
+        # trail names the cause" contract the chaos drill gates on.
+        with open(os.path.join(bundles[0]["path"],
+                               "decisions.jsonl")) as f:
+            trail = [json.loads(line) for line in f if line.strip()]
+        assert any(d.get("decision") == "fault"
+                   and "bad" in d["signals"].get("request_ids", ())
+                   for d in trail)
+        # Lifecycle + chunk rings populated by the serve.
+        assert rec.rings["lifecycle"] and rec.rings["chunks"]
+
+
+def test_scheduler_shed_decision(engine):
+    from fairness_llm_tpu.config import OverloadConfig, ServingConfig
+    from fairness_llm_tpu.serving import ContinuousScheduler, Request
+
+    with use_registry(), use_timeline(), use_flight_recorder() as rec, \
+            use_incident_manager():
+        sched = ContinuousScheduler(
+            engine,
+            ServingConfig(enabled=True, num_slots=2, max_new_tokens=8),
+            settings=_greedy(8),
+            overload=OverloadConfig(enabled=True),
+        )
+        sched.shed_controller.level = 3  # interactive_only brownout
+        assert not sched.submit(Request(prompt="x", id="b1",
+                                        settings=_greedy(8), qos="batch"))
+        res = sched.take_result("b1")
+        assert res is not None and res.finish_reason == "shed"
+        ds = [d for d in _decisions(rec) if d["decision"] == "shed"]
+        assert len(ds) == 1 and ds[0]["action"] == "overload"
+        assert ds[0]["request_id"] == "b1"
+        assert ds[0]["signals"]["level"] == 3
+        _assert_schema(ds[0])
+
+
+# -- JSONL sink rotation (satellite) -------------------------------------------
+
+
+def test_jsonl_sink_rotation_and_merged_read(tmp_path):
+    from fairness_llm_tpu.telemetry.export import JsonlSink, read_events
+
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSink(path, max_bytes=300, keep=2)
+    for i in range(40):
+        sink.emit("tick", i=i)
+    sink.close()
+    assert sink.rotations > 2
+    assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")  # beyond keep: deleted
+    events = read_events(path)
+    # Merged oldest-first across generations, newest event last.
+    assert events[-1]["i"] == 39
+    idx = [e["i"] for e in events]
+    assert idx == sorted(idx)
+    # Old generations were dropped (bounded), not silently kept.
+    assert len(events) < 40
+
+
+def test_read_events_tolerates_torn_tails_in_every_generation(tmp_path):
+    from fairness_llm_tpu.telemetry.export import JsonlSink, read_events
+
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSink(path, max_bytes=200, keep=3)
+    for i in range(20):
+        sink.emit("tick", i=i)
+    sink.close()
+    # A kill can tear the final line of ANY generation.
+    for p in (path, path + ".1"):
+        with open(p, "a", encoding="utf-8") as f:
+            f.write('{"kind": "torn", "i":')
+    events = read_events(path)
+    assert events and all(e["kind"] == "tick" for e in events)
+
+
+def test_sink_rejects_bad_rotation_args(tmp_path):
+    from fairness_llm_tpu.telemetry.export import JsonlSink
+
+    with pytest.raises(ValueError):
+        JsonlSink(str(tmp_path / "e.jsonl"), max_bytes=0)
+    with pytest.raises(ValueError):
+        JsonlSink(str(tmp_path / "e.jsonl"), max_bytes=10, keep=0)
+
+
+def test_forbid_catches_trigger_whose_dump_failed(tmp_path, monkeypatch):
+    """A fired trigger whose dump died (contained exception, .partial
+    cleaned) leaves nothing on disk — the snapshot counter must still
+    fail --forbid-incidents."""
+    vt = _tool("validate_telemetry")
+    tel = str(tmp_path / "tel")
+    with use_registry() as reg, use_timeline(), use_flight_recorder():
+        mgr = IncidentManager(os.path.join(tel, "incidents"))
+        monkeypatch.setattr(
+            IncidentManager, "_write_json",
+            staticmethod(lambda *a: (_ for _ in ()).throw(OSError("full"))))
+        assert mgr.trigger("fence", "x", scope="r0") is None
+        assert T.list_bundles(os.path.join(tel, "incidents")) == []
+        T.write_snapshot(reg, tel)
+        assert vt.check(tel, forbid_incidents=True) == 1
+
+
+def test_read_events_survives_generation_gap(tmp_path):
+    """A kill between _rotate's two renames leaves .2 without .1 — the
+    reader must still find the orphaned generation."""
+    from fairness_llm_tpu.telemetry.export import read_events
+
+    path = str(tmp_path / "events.jsonl")
+    with open(path + ".2", "w", encoding="utf-8") as f:
+        f.write('{"kind": "old", "i": 0}\n')
+    with open(path, "w", encoding="utf-8") as f:
+        f.write('{"kind": "new", "i": 1}\n')
+    events = read_events(path)
+    assert [e["kind"] for e in events] == ["old", "new"]
+
+
+def test_reports_read_rotated_telemetry_dir(tmp_path, capsys):
+    """Regression (satellite): fairness-report and slo-report must keep
+    working on a telemetry dir whose events.jsonl has rotated."""
+    from fairness_llm_tpu.cli.main import fairness_report, slo_report
+    from fairness_llm_tpu.telemetry.export import JsonlSink
+
+    tel = str(tmp_path)
+    with use_registry() as reg, use_timeline():
+        reg.gauge("slo_burn_rate", component="serving", slo="error_rate",
+                  window="run").set(0.5)
+        reg.counter("fairness_requests_total", component="fairness").inc()
+        sink = JsonlSink(os.path.join(tel, "events.jsonl"),
+                         max_bytes=256, keep=2)
+        for i in range(12):
+            sink.emit("fairness_pair_divergent", pair_id=f"p{i}",
+                      attribute="drill", cause="decode_error",
+                      members={}, js_distance=0.0)
+        sink.close()
+        T.write_snapshot(reg, tel)
+    assert os.path.exists(os.path.join(tel, "events.jsonl.1"))
+    assert slo_report([tel]) == 0
+    assert fairness_report([tel]) == 0
+    out = capsys.readouterr().out
+    assert "SLO BURN RATES" in out
+    # The divergent-pair table joined events ACROSS generations: pairs
+    # whose events now live only in the rotated file still render.
+    assert "p0" in out or "pair" in out.lower()

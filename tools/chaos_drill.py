@@ -144,12 +144,46 @@ def main() -> int:
     sink = T.configure(a.telemetry_dir) if a.telemetry_dir else None
     journal_dir = a.journal_dir or tempfile.mkdtemp(prefix="chaos-journal-")
 
+    # Incident engine (ISSUE 13): armed for the WHOLE drill with a cooldown
+    # longer than any CI run, so per-(class, scope) dedup is absolute —
+    # each injected fault family must produce EXACTLY one bundle however
+    # many faults the storm lands. Bundles ride the telemetry artifact.
+    inc_dir = os.path.join(
+        a.telemetry_dir or tempfile.mkdtemp(prefix="chaos-incidents-"),
+        "incidents",
+    )
+    T.arm_incidents(inc_dir, cooldown_s=3600.0)
+
     problems = []
 
     def check(ok: bool, what: str) -> None:
         print(("PASS" if ok else "FAIL") + f"  {what}")
         if not ok:
             problems.append(what)
+
+    def bundles(cls: str, scope: str = None):
+        found = [m for m in T.list_bundles(inc_dir) if m["class"] == cls]
+        if scope is not None:
+            found = [m for m in found if m.get("scope") == scope]
+        return found
+
+    def bundle_fault_ids(manifest) -> set:
+        """Request ids named by the bundle's fault decisions — the
+        'decision trail names the injected cause' witness."""
+        import json as _json
+
+        ids = set()
+        with open(os.path.join(manifest["path"], "decisions.jsonl"),
+                  encoding="utf-8") as f:
+            for line in f:
+                try:
+                    d = _json.loads(line)
+                except _json.JSONDecodeError:
+                    continue
+                if d.get("decision") == "fault":
+                    ids.update((d.get("signals") or {}).get(
+                        "request_ids", ()))
+        return ids
 
     # Numerics guards armed: the injected NaN below must be caught by the
     # on-device finite flag, not delivered as garbage tokens.
@@ -187,6 +221,24 @@ def main() -> int:
           "the NaN corruption fired once")
     check(sorted(r["id"] for r in journal.unfinished()) == preempted,
           "journal unfinished == preempted set")
+
+    # Incident bundles for the section-2 fault families (ISSUE 13): the
+    # fault STORM above (five scripted faults, re-opens, a hang, a NaN)
+    # must dedup to exactly one bundle per family, each one's decision
+    # trail naming the injected cause.
+    injected = {"flaky", "doomed", "pfault", "hangme", "nanme"}
+    bo = bundles("breaker_open", scope="serving")
+    check(len(bo) == 1 and bool(bundle_fault_ids(bo[0]) & injected),
+          "exactly one breaker_open bundle; decision trail names the "
+          f"injected request(s) ({sorted(bundle_fault_ids(bo[0]) & injected) if bo else '-'})")
+    wh = bundles("watchdog_hang")
+    check(len(wh) == 1
+          and "hangme" in wh[0].get("context", {}).get("request_ids", ()),
+          "exactly one watchdog_hang bundle naming 'hangme'")
+    nf = bundles("numerics_fault")
+    check(len(nf) == 1
+          and "nanme" in nf[0].get("context", {}).get("request_ids", ()),
+          "exactly one numerics_fault bundle naming 'nanme'")
 
     # 3. Resume.
     resumed = resume_serving(engine, journal, serving=SERVING,
@@ -246,6 +298,9 @@ def main() -> int:
     except IntegrityError as e:
         check("model.safetensors" in str(e),
               f"bit-flipped shard refused, error names the file ({e})")
+    itf = bundles("integrity_fault")
+    check(len(itf) == 1 and "model.safetensors" in itf[0]["cause"],
+          "exactly one integrity_fault bundle naming the flipped shard")
 
     # 5. Canary: golden-prompt probe through a live scheduler matches the
     # static-engine reference; a tampered reference (the comparator's view
@@ -263,6 +318,9 @@ def main() -> int:
     check(not probe.probe(canary_sched) and board.state("decode") == "open"
           and board.ladder.level >= 1,
           "canary mismatch trips the breaker degradation ladder")
+    cm = bundles("canary_mismatch")
+    check(len(cm) == 1 and "wrong tokens" in cm[0]["cause"],
+          "exactly one canary_mismatch bundle (wrong-but-finite captured)")
 
     # 6. Fleet failover: 2 replicas, kill r1 mid-sweep — zero lost, migrated
     # survivors token-identical to the single-engine baseline, r0 serving
@@ -319,6 +377,19 @@ def main() -> int:
     check(fleet.last_failover_s is not None,
           f"failover recovery measured ({fleet.last_failover_s and round(fleet.last_failover_s, 4)}s "
           "fence -> first migrated token)")
+    fb = bundles("fence")
+    check(len(fb) == 1 and fb[0].get("replica") == "r1"
+          and "replica_crash" in fb[0]["cause"],
+          "exactly one fence bundle for r1 naming replica_crash")
+    if fb:
+        # The rendered postmortem: the causal chain must read from the
+        # fence back through the decisions that drove it.
+        report = T.render_incident_report(fb[0]["path"])
+        chain = next((ln for ln in report.splitlines()
+                      if ln.strip().startswith("fence(")), "")
+        print(f"  incident-report chain: {chain.strip()}")
+        check("fence(r1)" in chain,
+              "incident-report renders the fence causal chain")
 
     # 7. Overload brownout (ISSUE 8): offer ~3x the queue's capacity with
     # mixed QoS classes. The shed controller must walk the brownout ladder
@@ -503,6 +574,13 @@ def main() -> int:
     check(disparity >= 0.25,
           f"impaired-rate disparity gauge reflects the bias "
           f"({disparity:g})")
+    fa = bundles("fairness_alert", scope="drill")
+    pd = bundles("pair_divergence", scope="drill")
+    check(len(fa) == 1,
+          "exactly one fairness_alert bundle for the biased-fault family")
+    check(len(pd) == 1,
+          "exactly one pair_divergence bundle (second divergent pair "
+          "deduped into it)")
     if a.telemetry_dir:
         # The rendered fairness report rides the telemetry artifact — the
         # failure-evidence upload includes the attribution table.
@@ -606,6 +684,19 @@ def main() -> int:
         hits = [c for c in snap["counters"]
                 if c["name"] == name and c["value"] > 0]
         check(bool(hits), f"{name} > 0 in snapshot")
+    # Dedup proof (ISSUE 13): the drill's fault storm fired far more
+    # triggers than bundles — the suppressed counter is the difference.
+    suppressed = sum(c["value"] for c in snap["counters"]
+                     if c["name"] == "incident_suppressed_total")
+    triggers = sum(c["value"] for c in snap["counters"]
+                   if c["name"] == "incident_triggers_total")
+    n_bundles = len(T.list_bundles(inc_dir))
+    check(suppressed > 0 and triggers == suppressed + n_bundles,
+          f"incident dedup: {triggers:g} trigger(s) -> {n_bundles} "
+          f"bundle(s) + {suppressed:g} suppressed")
+    check(sum(c["value"] for c in snap["counters"]
+              if c["name"] == "decisions_total") > 0,
+          "decision audit trail recorded (decisions_total > 0)")
 
     if a.telemetry_dir:
         path = T.write_snapshot(T.get_registry(), a.telemetry_dir)

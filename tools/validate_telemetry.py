@@ -57,6 +57,14 @@ every program counted in ``compiles_total`` must have published a nonzero
 ``cost_ledger_bytes`` gauge (the jaxpr-walked analytical bytes per
 component), plus nonzero ``cost_wall_s_total`` accumulation so the
 ``perf-report`` gap decomposition is derivable from the snapshot.
+``--require-incidents`` requires the incident engine's evidence (ISSUE 13):
+at least one complete postmortem bundle under ``<dir>/incidents`` (manifest
+with a known class + cause, flight-recorder rings, decision trail, registry
+snapshot, trace slice — no torn ``.partial`` leftovers), a nonzero
+``decisions_total`` audit trail, and ``incident_bundles_total`` agreeing
+with the bundles on disk. ``--forbid-incidents`` is the inverse gate for
+fault-free runs: ZERO bundles — an incident bundle from a clean study is
+itself a defect.
 ``--require-fairness`` requires the fairness-observability signals a
 fault-free ``--fairness-obs --continuous`` study produces (ISSUE 9):
 nonzero ``fairness_requests_total`` and ``fairness_pairs_joined_total``,
@@ -89,9 +97,15 @@ def check(path: str, require_serving: bool = False,
           require_fairness: bool = False,
           require_prefix_cache: bool = False,
           require_autoscale: bool = False,
-          require_costmodel: bool = False) -> int:
+          require_costmodel: bool = False,
+          require_incidents: bool = False,
+          forbid_incidents: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
+    if require_incidents or forbid_incidents:
+        problems.extend(_check_incidents(path, snap,
+                                         require=require_incidents,
+                                         forbid=forbid_incidents))
     if require_profile:
         problems.extend(_check_profile(path, snap))
     if require_costmodel:
@@ -245,6 +259,55 @@ def check(path: str, require_serving: bool = False,
           f"({len(snap.get('counters', []))} counters, "
           f"{len(snap.get('histograms', []))} histograms)")
     return 0
+
+
+def _check_incidents(path: str, snap: dict, require: bool,
+                     forbid: bool) -> list:
+    """The --require-incidents / --forbid-incidents gates (ISSUE 13).
+    Bundle shape is validated by the telemetry layer itself
+    (``validate_incidents``); this adds the snapshot cross-checks — a
+    recorded decision trail and counter/bundle agreement."""
+    from fairness_llm_tpu.telemetry import validate_incidents
+    from fairness_llm_tpu.telemetry.incidents import (
+        INCIDENTS_DIRNAME,
+        list_bundles,
+    )
+
+    tel_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    problems = list(validate_incidents(tel_dir, require=require,
+                                       forbid=forbid))
+    counters = snap.get("counters", [])
+
+    def total(name):
+        return sum(c["value"] for c in counters if c.get("name") == name)
+
+    if forbid:
+        # Disk state alone can't see a trigger whose dump FAILED (the
+        # contained-exception path cleans its .partial): the snapshot
+        # counter can. Any counted trigger in a must-be-clean run is a
+        # violation, bundle or no bundle. (The counter only increments
+        # while the engine is armed, so an unarmed drill stays clean.)
+        fired = total("incident_triggers_total")
+        if fired:
+            problems.append(
+                f"incident_triggers_total = {fired:g} in a run that must "
+                "produce no incidents (a trigger fired — even if its "
+                "bundle dump failed)"
+            )
+    if not require:
+        return problems
+    if not total("decisions_total"):
+        problems.append("decisions_total is zero (the decision audit trail "
+                        "never recorded — recording switched off?)")
+    n_bundles = len(list_bundles(os.path.join(tel_dir, INCIDENTS_DIRNAME)))
+    counted = total("incident_bundles_total")
+    if n_bundles and counted != n_bundles:
+        problems.append(
+            f"incident_bundles_total ({counted:g}) != bundles on disk "
+            f"({n_bundles}) — bundles from another run, or a dump the "
+            "counter missed"
+        )
+    return problems
 
 
 def _check_costmodel(snap: dict) -> list:
@@ -545,6 +608,8 @@ def main() -> int:
     ap.add_argument("--require-prefix-cache", action="store_true")
     ap.add_argument("--require-autoscale", action="store_true")
     ap.add_argument("--require-costmodel", action="store_true")
+    ap.add_argument("--require-incidents", action="store_true")
+    ap.add_argument("--forbid-incidents", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
                  require_breaker=a.require_breaker,
@@ -555,7 +620,9 @@ def main() -> int:
                  require_fairness=a.require_fairness,
                  require_prefix_cache=a.require_prefix_cache,
                  require_autoscale=a.require_autoscale,
-                 require_costmodel=a.require_costmodel)
+                 require_costmodel=a.require_costmodel,
+                 require_incidents=a.require_incidents,
+                 forbid_incidents=a.forbid_incidents)
 
 
 if __name__ == "__main__":
