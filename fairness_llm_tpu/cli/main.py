@@ -133,6 +133,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slots", type=int, default=None,
                    help="with --continuous: concurrent KV slots "
                         "(= decode-step batch rows)")
+    p.add_argument("--fuse-steps", type=int, default=None, metavar="K",
+                   help="with --continuous: decode chunks fused into ONE "
+                        "compiled dispatch (runtime/stepbuilder.py) — the "
+                        "step program runs decode_chunk x K steps before "
+                        "returning to the host, amortizing per-dispatch "
+                        "host sync ~1/K per token at an identical token "
+                        "stream (per-row budgets clamp in-program). Not "
+                        "combinable with --speculate (its verify window is "
+                        "already multi-token; composition lands with tree "
+                        "speculation)")
     p.add_argument("--paged-kv", action="store_true",
                    help="with --continuous: paged KV cache with radix-tree "
                         "prefix reuse (serving/paged.py) — slots hold block "
@@ -393,19 +403,31 @@ def config_from_args(args: argparse.Namespace) -> Config:
             spec_kwargs["ngram_max"] = args.ngram_max
         updates["speculation"] = SpeculationConfig(**spec_kwargs)
     if args.continuous or args.slots is not None or args.paged_kv \
-            or args.kv_block_size is not None or args.kv_blocks is not None:
+            or args.kv_block_size is not None or args.kv_blocks is not None \
+            or args.fuse_steps is not None:
         from fairness_llm_tpu.config import ServingConfig
 
         if not args.paged_kv and (args.kv_block_size is not None
                                   or args.kv_blocks is not None):
             raise SystemExit("--kv-block-size/--kv-blocks require --paged-kv")
         if not args.continuous:
-            raise SystemExit("--slots/--paged-kv require --continuous")
+            raise SystemExit(
+                "--slots/--paged-kv/--fuse-steps require --continuous")
         serve_kwargs = {"enabled": True}
         if args.slots is not None:
             if args.slots < 1:
                 raise SystemExit("--slots must be >= 1")
             serve_kwargs["num_slots"] = args.slots
+        if args.fuse_steps is not None:
+            if args.fuse_steps < 1:
+                raise SystemExit("--fuse-steps must be >= 1")
+            if args.fuse_steps > 1 and args.speculate:
+                raise SystemExit(
+                    "--fuse-steps cannot combine with --speculate: the "
+                    "speculative verify window is already multi-token; "
+                    "fused tree speculation is deferred to the "
+                    "tree-speculation PR")
+            serve_kwargs["fuse_steps"] = args.fuse_steps
         if args.paged_kv:
             serve_kwargs["paged_kv"] = True
             if args.kv_block_size is not None:

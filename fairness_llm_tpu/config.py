@@ -74,6 +74,19 @@ class ServingConfig:
     # per-call dispatch/copy overhead, smaller chunks backfill freed slots
     # sooner (an evicted row's slot idles at most decode_chunk-1 steps).
     decode_chunk: int = 8
+    # Fused multi-step dispatch (--fuse-steps, runtime/stepbuilder.py):
+    # fold k decode chunks into ONE compiled dispatch — the step program
+    # runs decode_chunk x fuse_steps steps before returning to the host,
+    # so per-dispatch host work (eviction sweep, queue polls, telemetry,
+    # the blocking device_get) amortizes 1/k per token. The token stream
+    # is identical at any k (per-row caps/EOS stops advance in-program and
+    # the loop early-exits once every live row finishes); the trade is
+    # latency granularity — eviction/backfill, drain polls, breaker feeds,
+    # and watchdog observes all move to the fused-dispatch boundary, and a
+    # contained fault discards up to k chunks of work. Composition with
+    # --speculate (whose verify window is already multi-token) is deferred
+    # to the tree-speculation PR and refused at flag parse.
+    fuse_steps: int = 1
     # Optional admission rate limit (RateLimiter.try_acquire at submit);
     # None = no quota. Exists for parity with the reference's API-era
     # limiter and for multi-tenant deployments.

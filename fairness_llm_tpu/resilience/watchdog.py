@@ -93,13 +93,18 @@ class StepWatchdog:
         elapsed: Optional[float] = None,
         extra_s: float = 0.0,
         classify: bool = True,
+        budget_scale: float = 1.0,
     ) -> float:
         """Record one completed step and classify it.
 
         ``elapsed`` overrides the armed-clock measurement (callers that
         already timed the call); ``extra_s`` adds simulated hang seconds from
         the fault injector so chaos drills never really sleep. Raises
-        :class:`HangFault` when the total exceeds ``max_step_seconds``.
+        :class:`HangFault` when the total exceeds ``max_step_seconds``
+        (times ``budget_scale`` — the fused-dispatch caller scales the
+        budget by its fuse factor, since one fused call legitimately runs
+        k chunks' worth of wall and a threshold tuned for one chunk would
+        classify every healthy fused dispatch as a hang).
 
         ``classify=False`` records the histogram but skips classification —
         for steps whose wall legitimately includes one-off work the budget
@@ -115,22 +120,23 @@ class StepWatchdog:
         else:
             self._armed.pop(stage, None)
         total = float(elapsed) + float(extra_s)
+        budget = self.max_step_seconds * max(float(budget_scale), 1.0)
         reg = get_registry()
         reg.histogram("step_wall_s", component=self.component,
                       stage=stage, **self.labels).observe(total)
         reg.gauge("watchdog_last_step_s", component=self.component,
                   **self.labels).set(total)
         mark_step_completed(self.component, self.clock, self.labels)
-        if self.max_step_seconds > 0 and total > self.max_step_seconds \
+        if self.max_step_seconds > 0 and total > budget \
                 and (classify or extra_s > 0):
             reg.counter("watchdog_hangs_total", component=self.component,
                         stage=stage, **self.labels).inc()
             emit_event("watchdog_hang", component=self.component, stage=stage,
                        step_s=round(total, 3),
-                       max_step_seconds=self.max_step_seconds, **self.labels)
+                       max_step_seconds=budget, **self.labels)
             raise HangFault(
                 f"{self.component} {stage} step took {total:.3f}s "
-                f"(> max_step_seconds {self.max_step_seconds:g})"
+                f"(> max_step_seconds {budget:g})"
             )
         return total
 

@@ -44,15 +44,18 @@ import flax.linen as nn
 from fairness_llm_tpu.config import MeshConfig, ModelSettings, SpeculationConfig
 from fairness_llm_tpu.models.configs import ModelConfig
 from fairness_llm_tpu.models.tokenizer import tokenizer_for
-from fairness_llm_tpu.models.transformer import Transformer, init_cache
+from fairness_llm_tpu.models.transformer import Transformer
 from fairness_llm_tpu.parallel import sharding as shd
 from fairness_llm_tpu.runtime.sampling import (
     SamplerSettings,
-    greedy_accept_length,
-    make_sampler,
     speculation_applicable,
 )
-from fairness_llm_tpu.runtime.speculative import ngram_draft
+from fairness_llm_tpu.runtime.stepbuilder import (
+    build_engine_decode,
+    build_prefix,
+    build_spec_decode,
+    compile_key,
+)
 from fairness_llm_tpu.telemetry import get_registry
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
 from fairness_llm_tpu.telemetry.costmodel import instrument_jit, note_invocation
@@ -268,44 +271,24 @@ class DecodeEngine:
 
     def _prefix_fn(self, prefix_len: int):
         """Compiled forward over the shared prompt prefix [1, Pc] -> per-layer
-        (k, v) arrays [Pc, Hkv, D] every batch row reads (but never copies)."""
-        key = ("prefix", prefix_len)
+        (k, v) arrays [Pc, Hkv, D] every batch row reads (but never copies).
+        A ``stepbuilder`` composition, like every compiled program here."""
+        key = compile_key("prefix", prefix_len=prefix_len)
         fn = self._compiled.get(key)
         note_lookup("prefix", hit=fn is not None)
         if fn is not None:
             return fn
-        cfg = self.config
-        model = self.model
-
-        def run(params, tokens):
-            positions = jnp.arange(prefix_len, dtype=jnp.int32)[None, :]
-            cache = init_cache(cfg, 1, prefix_len)
-            _, cache = model.apply(
-                {"params": params}, tokens, positions,
-                jnp.ones((1, prefix_len), jnp.bool_), cache,
-                left_padded=True, last_only=True,
-            )
-            out = []
-            for layer in cache.layers:
-                if cfg.kv_cache_quant:
-                    from fairness_llm_tpu.models.transformer import _dequantize_kv
-
-                    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-                    out.append((
-                        _dequantize_kv(layer.k, layer.k_scale, dtype)[0],
-                        _dequantize_kv(layer.v, layer.v_scale, dtype)[0],
-                    ))
-                else:
-                    out.append((layer.k[0], layer.v[0]))
-            return tuple(out)
-
-        fn = instrument_jit(run, "prefix")
+        fn = instrument_jit(
+            build_prefix(self.config, self.model, prefix_len=prefix_len),
+            "prefix",
+        )
         self._compiled[key] = fn
         return fn
 
     def _decode_fn(self, batch: int, prompt_len: int, max_new: int,
                    sampler_settings: SamplerSettings, prefix_len: int = 0,
                    guard: bool = False):
+        # One compile-key scheme for every program (stepbuilder.compile_key).
         # The leading "decode" tag IS the speculation slot of the compile
         # key: speculative programs live under disjoint ("spec_decode", ...,
         # ngram_max, draft_len) keys (and their shapes/returns differ), so
@@ -313,90 +296,25 @@ class DecodeEngine:
         # other mode (pinned by test_spec_compile_keys_disjoint). ``guard``
         # (the numerics-guard flag) changes the return arity, so it is part
         # of the key for the same stale-program reason.
-        key = ("decode", batch, prompt_len, max_new, sampler_settings,
-               prefix_len, guard)
+        key = compile_key("decode", batch=batch, prompt_len=prompt_len,
+                          max_new=max_new, sampler=sampler_settings,
+                          prefix_len=prefix_len, guard=guard)
         fn = self._compiled.get(key)
         note_lookup("decode", hit=fn is not None)
         if fn is not None:
             return fn
-
-        cfg = self.config
-        model = self.model
-        sample = make_sampler(sampler_settings)
-        pad_id = self.tokenizer.pad_id
-        eos_id = self.tokenizer.eos_id
-        if guard:
-            from fairness_llm_tpu.integrity.numerics import masked_finite
-
-        def run(params, tokens, valid, row_seeds, row_live, shared_layers):
-            # positions: global (prefix offset + 0..len-1); pad slots clamped
-            positions = prefix_len + jnp.maximum(
-                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
-            )
-            cache = init_cache(cfg, batch, prompt_len + max_new)
-            logits, cache = model.apply(
-                {"params": params}, tokens, positions, valid, cache,
-                left_padded=True, last_only=True, shared_layers=shared_layers,
-            )
-            last_logits = logits[:, -1, :]
-            # One independent key stream per row, derived from that row's seed
-            # alone — sampling must not depend on batch composition/position.
-            row_keys = jax.vmap(jax.random.key)(row_seeds)  # [B]
-
-            # while_loop (not scan): exits as soon as EVERY row has sampled
-            # EOS, so a sweep whose responses finish at 60 tokens doesn't pay
-            # for 128 steps of KV-cache streaming. Trip count is dynamic but
-            # bounded by max_new; output stays fixed-shape [B, max_new].
-            toks0 = jnp.full((batch, max_new), pad_id, jnp.int32)
-
-            def cond(carry):
-                step_idx, _, _, done = carry[0], carry[1], carry[2], carry[3]
-                return (step_idx < max_new) & ~jnp.all(done)
-
-            def body(carry):
-                step_idx, cache, prev_logits, done, toks = carry[:5]
-                step_keys = jax.vmap(jax.random.fold_in, (0, None))(row_keys, step_idx)
-                tok = sample(prev_logits, step_keys)
-                tok = jnp.where(done, pad_id, tok)
-                toks = jax.lax.dynamic_update_slice(
-                    toks, tok[:, None], (jnp.zeros((), jnp.int32), step_idx)
-                )
-                done_next = done | (tok == eos_id)
-                step_valid = ~done  # the just-sampled token is real iff row was live
-                pos = prefix_len + cache.lengths[:, None]
-                logits, cache = model.apply(
-                    {"params": params},
-                    tok[:, None],
-                    pos,
-                    step_valid[:, None],
-                    cache,
-                    shared_layers=shared_layers,
-                )
-                out = (step_idx + 1, cache, logits[:, -1, :], done_next, toks)
-                if guard:
-                    # Rows live this step contributed real logits; fold their
-                    # finiteness into the chunk flag (one reduced bool, read
-                    # with the tokens — never a per-token host sync).
-                    out += (carry[5] & masked_finite(logits[:, -1, :], step_valid),)
-                return out
-
-            # Bucket-padding rows start done: the early exit must wait only on
-            # REAL prompts, not on garbage rows happening to sample EOS.
-            done0 = ~row_live
-            init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, toks0)
-            if guard:
-                # Prefill's last logits are the first sample's distribution —
-                # the check covers them too (live rows only).
-                init += (masked_finite(last_logits, row_live),)
-                carry_out = jax.lax.while_loop(cond, body, init)
-                return carry_out[4], carry_out[5]  # toks [B, max_new], finite
-            _, _, _, _, toks = jax.lax.while_loop(cond, body, init)
-            return toks  # [B, max_new]
-
-        # shared_layers is a pytree arg: None (empty pytree) when no prefix.
-        # instrument_jit = jax.jit + the cost ledger (telemetry/costmodel.py):
-        # the first attribution-on call walks the program's jaxpr into
-        # cost_ledger_bytes/flops{program="decode"} gauges.
+        # The plain program is the builder's batch entry + the SHARED greedy
+        # while_loop skeleton (the same loop serve_step/paged_step run over
+        # the slot pool) with a uniform cap. instrument_jit = jax.jit + the
+        # cost ledger (telemetry/costmodel.py): the first attribution-on
+        # call walks the program's jaxpr into cost_ledger_bytes/flops
+        # {program="decode"} gauges.
+        run = build_engine_decode(
+            self.config, self.model, sampler_settings,
+            self.tokenizer.pad_id, self.tokenizer.eos_id, batch=batch,
+            prompt_len=prompt_len, max_new=max_new, prefix_len=prefix_len,
+            guard=guard,
+        )
         fn = instrument_jit(run, "decode")
         self._compiled[key] = fn
         return fn
@@ -420,166 +338,23 @@ class DecodeEngine:
         window always overwrites them. The cache carries ``draft_len`` spare
         slots so the last verify window of a nearly-finished row still fits.
         """
-        k = spec.draft_len
         # ``guard`` sits mid-key (not last): the speculation knobs stay the
         # key's trailing pair, which diagnostics (and the compile-key test)
-        # rely on.
-        key = ("spec_decode", batch, prompt_len, max_new, prefix_len,
-               guard, spec.ngram_max, k)
+        # rely on. See stepbuilder.compile_key for the one scheme.
+        key = compile_key("spec_decode", batch=batch, prompt_len=prompt_len,
+                          max_new=max_new, prefix_len=prefix_len,
+                          guard=guard, ngram_max=spec.ngram_max,
+                          draft_len=spec.draft_len)
         fn = self._compiled.get(key)
         note_lookup("spec_decode", hit=fn is not None)
         if fn is not None:
             return fn
-
-        cfg = self.config
-        model = self.model
-        pad_id = self.tokenizer.pad_id
-        eos_id = self.tokenizer.eos_id
-        if guard:
-            from fairness_llm_tpu.integrity.numerics import masked_finite
-        S = k + 1
-        cache_len = prompt_len + max_new + k
-        gen_len = max_new + k  # emit buffer widened so a verify window never
-        # needs clamped writes; sliced back to max_new on return
-
-        def run(params, tokens, valid, row_live, shared_layers, prefix_toks):
-            positions = prefix_len + jnp.maximum(
-                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
-            )
-            cache = init_cache(cfg, batch, cache_len)
-            logits, cache = model.apply(
-                {"params": params}, tokens, positions, valid, cache,
-                left_padded=True, last_only=True, shared_layers=shared_layers,
-            )
-            last_logits = logits[:, -1, :]
-
-            # Lookup context: [shared prefix | left-padded remainder | gen].
-            # The prefix is identical across rows; pad gaps between segments
-            # are masked out of n-gram matching by ctx_valid.
-            pref_tile = jnp.broadcast_to(
-                prefix_toks[None, :], (batch, prefix_len)
-            )
-            ctx_prompt = jnp.concatenate([pref_tile, tokens], axis=1)
-            ctx_prompt_valid = jnp.concatenate(
-                [jnp.ones((batch, prefix_len), bool), valid], axis=1
-            )
-            gen_start = prefix_len + prompt_len
-            gpos = jnp.arange(gen_len, dtype=jnp.int32)[None, :]
-            step_iota = jnp.arange(S, dtype=jnp.int32)
-
-            gen0 = jnp.full((batch, gen_len), pad_id, jnp.int32)
-            out_len0 = jnp.zeros((batch,), jnp.int32)
-            done0 = ~row_live
-            counters0 = jnp.zeros((3,), jnp.int32)  # drafted, accepted, steps
-
-            def cond(carry):
-                step_idx, done = carry[0], carry[3]
-                return (step_idx < max_new) & ~jnp.all(done)
-
-            def body(carry):
-                step_idx, cache, prev_logits, done, gen, out_len, counters = \
-                    carry[:7]
-                live = ~done
-                # The step's guaranteed token: greedy argmax of the carried
-                # logits (identical to the plain loop's sample at temp 0).
-                t0 = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)
-                t0 = jnp.where(live, t0, pad_id)
-                # Drafts via n-gram lookup over history INCLUDING t0.
-                gen_t0 = jnp.where(
-                    (gpos == out_len[:, None]) & live[:, None],
-                    t0[:, None], gen,
-                )
-                ctx = jnp.concatenate([ctx_prompt, gen_t0], axis=1)
-                ctx_valid = jnp.concatenate(
-                    [ctx_prompt_valid, gpos <= out_len[:, None]], axis=1
-                )
-                hist_end = gen_start + out_len + 1
-                drafts = ngram_draft(
-                    ctx, ctx_valid, hist_end, k, spec.ngram_max, pad_id
-                )
-                inp = jnp.concatenate([t0[:, None], drafts], axis=1)  # [B, S]
-
-                # Verify all S positions in one forward; per-row write slots.
-                off = jnp.minimum(prompt_len + out_len, cache_len - S)
-                pos = prefix_len + cache.lengths[:, None] + step_iota[None, :]
-                tv = jnp.broadcast_to(live[:, None], (batch, S))
-                logits, nc = model.apply(
-                    {"params": params}, inp, pos, tv, cache,
-                    shared_layers=shared_layers, write_offsets=off,
-                )
-                g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
-                # g[:, i] is the model's token AFTER input position i, so
-                # g[:, :k] checks drafts (= inp[:, 1:]).
-                a = greedy_accept_length(drafts, g[:, :k])  # [B] in [0, k]
-
-                # Emitted count e: accepted prefix, truncated at the first
-                # EOS (inclusive — plain decode records EOS then stops) and
-                # at the max_new cap; 0 for done rows.
-                eos_first = jnp.min(
-                    jnp.where(inp == eos_id, step_iota[None, :], S), axis=1
-                )
-                e = jnp.minimum(a + 1, eos_first + 1)
-                e = jnp.minimum(e, max_new - out_len)
-                e = jnp.where(live, e, 0)
-
-                # Scatter the emitted window into the output buffer.
-                widx = gpos - out_len[:, None]  # [B, gen_len]
-                wtok = jnp.take_along_axis(
-                    inp, jnp.clip(widx, 0, S - 1), axis=1
-                )
-                gen = jnp.where((widx >= 0) & (widx < e[:, None]), wtok, gen)
-
-                # Carry logits after the LAST emitted token (the next step's
-                # greedy distribution — this is what makes acceptance exact).
-                pick = jnp.clip(e - 1, 0, S - 1)
-                nl = jnp.take_along_axis(
-                    logits,
-                    jnp.broadcast_to(
-                        pick[:, None, None], (batch, 1, logits.shape[-1])
-                    ),
-                    axis=1,
-                )[:, 0]
-                prev_logits = jnp.where(live[:, None], nl, prev_logits)
-
-                # Cache fixups: invalidate rejected window slots (the next
-                # window starts at off+e and always covers them) and advance
-                # lengths by the ACCEPTED count, not the window width.
-                slot = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
-                wpos = slot - off[:, None]
-                in_win = (wpos >= 0) & (wpos < S)
-                fixed_valid = nc.key_valid & ~(in_win & (wpos >= e[:, None]))
-                nc = nc.replace(
-                    key_valid=fixed_valid, lengths=cache.lengths + e
-                )
-
-                out_len = out_len + e
-                done = done | (live & (eos_first < e)) | (out_len >= max_new)
-                counters = counters + jnp.stack([
-                    k * jnp.sum(live, dtype=jnp.int32),
-                    jnp.sum(jnp.maximum(e - 1, 0), dtype=jnp.int32),
-                    jnp.ones((), jnp.int32),
-                ])
-                out = (step_idx + 1, nc, prev_logits, done, gen, out_len,
-                       counters)
-                if guard:
-                    # The whole [B, S, V] verify window must be finite: the
-                    # accepted tokens AND the carried next-step logits both
-                    # come out of it.
-                    out += (carry[7] & masked_finite(logits, live),)
-                return out
-
-            init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, gen0,
-                    out_len0, counters0)
-            if guard:
-                init += (masked_finite(last_logits, row_live),)
-                carry_out = jax.lax.while_loop(cond, body, init)
-                return (carry_out[4][:, :max_new], carry_out[5], carry_out[6],
-                        carry_out[7])
-            _, _, _, _, gen, out_len, counters = jax.lax.while_loop(
-                cond, body, init
-            )
-            return gen[:, :max_new], out_len, counters
-
+        run = build_spec_decode(
+            self.config, self.model, self.tokenizer.pad_id,
+            self.tokenizer.eos_id, batch=batch, prompt_len=prompt_len,
+            max_new=max_new, prefix_len=prefix_len,
+            ngram_max=spec.ngram_max, draft_len=spec.draft_len, guard=guard,
+        )
         fn = instrument_jit(run, "spec_decode")
         self._compiled[key] = fn
         return fn

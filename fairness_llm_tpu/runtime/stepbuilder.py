@@ -1,0 +1,659 @@
+"""One step-program builder for every compiled decode variant.
+
+Before this module, the stack carried six hand-threaded compiled decode
+programs — the static engine's plain ``decode`` and speculative
+``spec_decode``, the serving scheduler's ``serve_prefill``/``serve_step``,
+and the paged-KV ``paged_prefill``/``paged_step`` — each re-implementing
+the same while-loop skeleton, guard layering, and compile-key bookkeeping
+by hand. Every cross-cutting feature (the numerics guard changing return
+arity, mutable ``decode_chunk`` compile keys, paged gather/scatter,
+per-row write offsets) had to be woven through each variant separately,
+and every planned decode mode (fused dispatch, real-mesh sharding, tree
+verify, sampling) would have multiplied the count again.
+
+This module collapses them into compositions over four orthogonal axes:
+
+- **KV source** — contiguous (private cache rows; released-slot reset mask
+  rides the program entry) or paged (block tables gathered into a
+  contiguous view at entry, private blocks scattered back at exit);
+- **token selection** — greedy/sampled single-token steps
+  (:func:`make_greedy_loop`, the ONE while-loop skeleton the plain engine
+  decode, ``serve_step``, and ``paged_step`` all run) or the speculative
+  draft-and-verify window (:func:`build_spec_decode`);
+- **guard layer** — ``guard=True`` folds the on-device finite check
+  (``integrity/numerics.masked_finite``) into the carry as one AND-reduced
+  flag, appended to the return tuple (arity change = compile-key axis);
+- **fuse factor** — ``fuse=k`` runs ``k`` decode chunks' worth of steps
+  inside ONE compiled dispatch (the Kernel-Looping move: per-step host
+  sync amortizes 1/k) with per-row live masks, caps, and write offsets
+  advancing in-program, so continuous batching, paged block tables, and
+  the guard compose unchanged. Fused programs publish under their own
+  telemetry label (:func:`program_label`) so the cost ledger, roofline
+  gauges, and host-gap accounting attribute them separately.
+
+Compile keys come from ONE scheme (:func:`compile_key`) instead of
+per-site tuple literals. Key invariants the rest of the stack relies on
+(pinned in tests): ``key[0]`` is the program's base name — the speculation
+slot, so plain/speculative programs can never alias; the guard flag is the
+last element of ``decode`` keys and sits mid-key on ``spec_decode`` keys
+(whose trailing pair stays ``(ngram_max, draft_len)``); step keys carry
+``(chunk, guard, fuse)`` so the degradation ladder's halved chunk and a
+fused dispatch each compile their own program and restoring reuses the
+original.
+
+Behavioral contract: every composition is token-for-token identical to the
+hand-threaded program it replaced — the whole pre-existing parity/golden/
+chaos test surface is the regression net, plus the dedicated harness in
+``tests/test_stepbuilder.py`` enumerating the axis grid.
+
+Callers (``runtime/engine.py``, ``serving/scheduler.py``) keep their own
+``_compiled`` dicts and host-side dispatch/telemetry; this module owns the
+device-program construction and the key scheme.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fairness_llm_tpu.models.transformer import LayerCache, init_cache
+from fairness_llm_tpu.runtime.sampling import (
+    SamplerSettings,
+    greedy_accept_length,
+    make_sampler,
+)
+from fairness_llm_tpu.runtime.speculative import ngram_draft
+
+#: The decode-loop step programs a scheduler can dispatch, by (paged, fused).
+STEP_PROGRAMS = ("serve_step", "paged_step",
+                 "serve_step_fused", "paged_step_fused")
+
+
+def program_label(base: str, fuse: int = 1) -> str:
+    """Telemetry name for a step program: fused dispatches (``fuse > 1``)
+    publish under ``<base>_fused`` so their compile stats, cost ledger,
+    roofline gauges, and host-gap accounting read apart from the per-chunk
+    baseline (``validate_telemetry`` requires a fused program seen in
+    ``compiles_total`` to publish all three)."""
+    return base if fuse <= 1 else f"{base}_fused"
+
+
+def compile_key(program: str, *, batch: Optional[int] = None,
+                prompt_len: Optional[int] = None,
+                max_new: Optional[int] = None,
+                sampler: Optional[SamplerSettings] = None,
+                prefix_len: int = 0, guard: bool = False,
+                ngram_max: Optional[int] = None,
+                draft_len: Optional[int] = None,
+                chunk: Optional[int] = None, fuse: int = 1,
+                nb: Optional[int] = None, P: Optional[int] = None) -> Tuple:
+    """The one compile-key scheme for every step program.
+
+    Axes are per-program-shape (batch/prompt buckets, decode caps), plus
+    the cross-cutting ones every variant shares: the guard flag (return
+    arity), the mutable ``decode_chunk``, paged-ness (via the base name),
+    and the fuse factor. See the module docstring for the pinned layout
+    invariants.
+    """
+    if program == "prefix":
+        return ("prefix", prefix_len)
+    if program == "decode":
+        return ("decode", batch, prompt_len, max_new, sampler, prefix_len,
+                guard)
+    if program == "spec_decode":
+        # ``guard`` sits mid-key: the speculation knobs stay the trailing
+        # pair, which diagnostics (and the compile-key test) rely on.
+        return ("spec_decode", batch, prompt_len, max_new, prefix_len,
+                guard, ngram_max, draft_len)
+    if program in ("serve_prefill", "paged_prefill"):
+        return (program, nb, P, guard)
+    if program in ("serve_step", "paged_step"):
+        return (program, chunk, guard, fuse)
+    raise ValueError(f"unknown step program {program!r}")
+
+
+# -- shared pieces -------------------------------------------------------------
+
+
+def _masked_finite():
+    # Lazy: integrity/ is only touched when a guard layer is actually
+    # composed in, mirroring the pre-builder call sites.
+    from fairness_llm_tpu.integrity.numerics import masked_finite
+
+    return masked_finite
+
+
+def make_batch_entry(cfg, model, *, batch: int, cache_len: int,
+                     prefix_len: int = 0):
+    """The left-padded batch prefill every ENGINE program starts with:
+    positions from the valid cumsum (prefix-offset, pad slots clamped), a
+    fresh cache of ``cache_len`` slots, one forward with ``last_only``
+    logits. Returns ``entry(params, tokens, valid, shared_layers) ->
+    (last_logits, cache)``."""
+
+    def entry(params, tokens, valid, shared_layers):
+        positions = prefix_len + jnp.maximum(
+            jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
+        )
+        cache = init_cache(cfg, batch, cache_len)
+        logits, cache = model.apply(
+            {"params": params}, tokens, positions, valid, cache,
+            left_padded=True, last_only=True, shared_layers=shared_layers,
+        )
+        return logits[:, -1, :], cache
+
+    return entry
+
+
+def make_greedy_loop(model, sample, pad_id: int, eos_id: int, *, batch: int,
+                     steps: int, guard: bool, prefix_len: int = 0,
+                     per_row_offsets: bool = False):
+    """The shared greedy/sampled decode ``while_loop`` — the skeleton the
+    plain engine decode, ``serve_step``, ``paged_step``, and their fused
+    variants all run.
+
+    Per iteration: sample from the carried logits with the row's own
+    ``fold_in(emitted)`` key stream (identical to the engine's
+    ``fold_in(step)`` stream — a live row's emitted count IS the step
+    index), write the token at the chunk column, forward one token with
+    the row's validity mask, carry the new logits, advance per-row
+    ``emitted``/``done`` (EOS or the row's cap). Early exit when every
+    live row finishes. ``per_row_offsets`` threads ``write_offsets =
+    base + emitted`` into the cache write (the serving slot layout);
+    without it the cache writes at its own lengths (the engine layout).
+
+    Returns ``loop(params, cache, prev_logits, row_seeds, emitted0, base,
+    caps, live0, shared_layers) -> final carry`` with layout
+    ``(t, cache, prev_logits, done, emitted, toks, counters[, finite])``
+    — ``toks`` is the ``[batch, steps]`` pad-filled emit buffer,
+    ``counters`` is ``[steps_run, live_row_steps]``.
+    """
+    B, T = batch, steps
+    masked_finite = _masked_finite() if guard else None
+
+    def loop(params, cache, prev_logits, row_seeds, emitted0, base, caps,
+             live0, shared_layers):
+        row_keys = jax.vmap(jax.random.key)(row_seeds)
+        toks0 = jnp.full((B, T), pad_id, jnp.int32)
+        done0 = ~live0
+        counters0 = jnp.zeros((2,), jnp.int32)  # steps, live-row-steps
+
+        def cond(carry):
+            t, done = carry[0], carry[3]
+            return (t < T) & ~jnp.all(done)
+
+        def body(carry):
+            t, cache, prev_logits, done, emitted, toks, counters = carry[:7]
+            live = ~done
+            step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
+            tok = sample(prev_logits, step_keys)
+            tok = jnp.where(live, tok, pad_id)
+            toks = jax.lax.dynamic_update_slice(
+                toks, tok[:, None], (jnp.zeros((), jnp.int32), t)
+            )
+            pos = cache.lengths[:, None]
+            if prefix_len:
+                pos = prefix_len + pos
+            apply_kwargs = dict(shared_layers=shared_layers)
+            if per_row_offsets:
+                apply_kwargs["write_offsets"] = base + emitted
+            logits, cache = model.apply(
+                {"params": params}, tok[:, None], pos, live[:, None],
+                cache, **apply_kwargs,
+            )
+            prev_logits = jnp.where(
+                live[:, None], logits[:, -1, :], prev_logits
+            )
+            emitted = emitted + live.astype(jnp.int32)
+            done = done | (tok == eos_id) | (emitted >= caps)
+            counters = counters + jnp.stack(
+                [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
+            )
+            out = (t + 1, cache, prev_logits, done, emitted, toks, counters)
+            if guard:
+                out += (carry[7] & masked_finite(logits[:, -1, :], live),)
+            return out
+
+        init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
+                emitted0, toks0, counters0)
+        if guard:
+            # Entry check covers the CARRIED logits (the sample source —
+            # where host-side NaN injection, and a poisoned prefill that
+            # slipped a disabled guard, would sit). Live rows only:
+            # released slots legitimately carry stale garbage.
+            init += (masked_finite(prev_logits, live0),)
+        return jax.lax.while_loop(cond, body, init)
+
+    return loop
+
+
+# -- engine programs (one dispatch = prefill + full decode) --------------------
+
+
+def build_engine_decode(cfg, model, sampler: SamplerSettings, pad_id: int,
+                        eos_id: int, *, batch: int, prompt_len: int,
+                        max_new: int, prefix_len: int, guard: bool):
+    """The static engine's plain program: batch entry + the shared greedy
+    loop with a uniform cap (every row's budget is ``max_new``, so per-row
+    caps coincide with the loop bound) and engine-layout cache writes (no
+    per-row offsets — each row's KV appends at its own length)."""
+    sample = make_sampler(sampler)
+    entry = make_batch_entry(cfg, model, batch=batch,
+                             cache_len=prompt_len + max_new,
+                             prefix_len=prefix_len)
+    loop = make_greedy_loop(model, sample, pad_id, eos_id, batch=batch,
+                            steps=max_new, guard=guard,
+                            prefix_len=prefix_len, per_row_offsets=False)
+
+    def run(params, tokens, valid, row_seeds, row_live, shared_layers):
+        last_logits, cache = entry(params, tokens, valid, shared_layers)
+        c = loop(params, cache, last_logits, row_seeds,
+                 jnp.zeros((batch,), jnp.int32), None,
+                 jnp.full((batch,), max_new, jnp.int32), row_live,
+                 shared_layers)
+        if guard:
+            return c[5], c[7]  # toks [B, max_new], finite
+        return c[5]
+
+    return run
+
+
+def build_spec_decode(cfg, model, pad_id: int, eos_id: int, *, batch: int,
+                      prompt_len: int, max_new: int, prefix_len: int,
+                      ngram_max: int, draft_len: int, guard: bool):
+    """The speculative selection body: greedy draft-and-verify.
+
+    One while_loop iteration = ONE multi-token verify forward over
+    ``k+1 = draft_len+1`` positions per row (the greedy next token plus k
+    prompt-lookup drafts), accepting the longest prefix matching greedy
+    argmax — so each iteration emits 1..k+1 tokens per row while streaming
+    params/KV once, vs once PER TOKEN on the greedy loop. Token-for-token
+    identical to the plain greedy composition by construction (parity
+    pinned in tests/test_speculative.py and tests/test_stepbuilder.py).
+
+    Rows advance at their own acceptance rates, so cache writes use
+    per-row ``write_offsets`` (slot = prompt_len + tokens emitted) and
+    rejected slots are re-invalidated after each step; the next step's
+    window always overwrites them. The cache carries ``draft_len`` spare
+    slots so the last verify window of a nearly-finished row still fits.
+    """
+    k = draft_len
+    masked_finite = _masked_finite() if guard else None
+    S = k + 1
+    cache_len = prompt_len + max_new + k
+    gen_len = max_new + k  # emit buffer widened so a verify window never
+    # needs clamped writes; sliced back to max_new on return
+    entry = make_batch_entry(cfg, model, batch=batch, cache_len=cache_len,
+                             prefix_len=prefix_len)
+
+    def run(params, tokens, valid, row_live, shared_layers, prefix_toks):
+        last_logits, cache = entry(params, tokens, valid, shared_layers)
+
+        # Lookup context: [shared prefix | left-padded remainder | gen].
+        # The prefix is identical across rows; pad gaps between segments
+        # are masked out of n-gram matching by ctx_valid.
+        pref_tile = jnp.broadcast_to(
+            prefix_toks[None, :], (batch, prefix_len)
+        )
+        ctx_prompt = jnp.concatenate([pref_tile, tokens], axis=1)
+        ctx_prompt_valid = jnp.concatenate(
+            [jnp.ones((batch, prefix_len), bool), valid], axis=1
+        )
+        gen_start = prefix_len + prompt_len
+        gpos = jnp.arange(gen_len, dtype=jnp.int32)[None, :]
+        step_iota = jnp.arange(S, dtype=jnp.int32)
+
+        gen0 = jnp.full((batch, gen_len), pad_id, jnp.int32)
+        out_len0 = jnp.zeros((batch,), jnp.int32)
+        done0 = ~row_live
+        counters0 = jnp.zeros((3,), jnp.int32)  # drafted, accepted, steps
+
+        def cond(carry):
+            step_idx, done = carry[0], carry[3]
+            return (step_idx < max_new) & ~jnp.all(done)
+
+        def body(carry):
+            step_idx, cache, prev_logits, done, gen, out_len, counters = \
+                carry[:7]
+            live = ~done
+            # The step's guaranteed token: greedy argmax of the carried
+            # logits (identical to the plain loop's sample at temp 0).
+            t0 = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)
+            t0 = jnp.where(live, t0, pad_id)
+            # Drafts via n-gram lookup over history INCLUDING t0.
+            gen_t0 = jnp.where(
+                (gpos == out_len[:, None]) & live[:, None],
+                t0[:, None], gen,
+            )
+            ctx = jnp.concatenate([ctx_prompt, gen_t0], axis=1)
+            ctx_valid = jnp.concatenate(
+                [ctx_prompt_valid, gpos <= out_len[:, None]], axis=1
+            )
+            hist_end = gen_start + out_len + 1
+            drafts = ngram_draft(
+                ctx, ctx_valid, hist_end, k, ngram_max, pad_id
+            )
+            inp = jnp.concatenate([t0[:, None], drafts], axis=1)  # [B, S]
+
+            # Verify all S positions in one forward; per-row write slots.
+            off = jnp.minimum(prompt_len + out_len, cache_len - S)
+            pos = prefix_len + cache.lengths[:, None] + step_iota[None, :]
+            tv = jnp.broadcast_to(live[:, None], (batch, S))
+            logits, nc = model.apply(
+                {"params": params}, inp, pos, tv, cache,
+                shared_layers=shared_layers, write_offsets=off,
+            )
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+            # g[:, i] is the model's token AFTER input position i, so
+            # g[:, :k] checks drafts (= inp[:, 1:]).
+            a = greedy_accept_length(drafts, g[:, :k])  # [B] in [0, k]
+
+            # Emitted count e: accepted prefix, truncated at the first
+            # EOS (inclusive — plain decode records EOS then stops) and
+            # at the max_new cap; 0 for done rows.
+            eos_first = jnp.min(
+                jnp.where(inp == eos_id, step_iota[None, :], S), axis=1
+            )
+            e = jnp.minimum(a + 1, eos_first + 1)
+            e = jnp.minimum(e, max_new - out_len)
+            e = jnp.where(live, e, 0)
+
+            # Scatter the emitted window into the output buffer.
+            widx = gpos - out_len[:, None]  # [B, gen_len]
+            wtok = jnp.take_along_axis(
+                inp, jnp.clip(widx, 0, S - 1), axis=1
+            )
+            gen = jnp.where((widx >= 0) & (widx < e[:, None]), wtok, gen)
+
+            # Carry logits after the LAST emitted token (the next step's
+            # greedy distribution — this is what makes acceptance exact).
+            pick = jnp.clip(e - 1, 0, S - 1)
+            nl = jnp.take_along_axis(
+                logits,
+                jnp.broadcast_to(
+                    pick[:, None, None], (batch, 1, logits.shape[-1])
+                ),
+                axis=1,
+            )[:, 0]
+            prev_logits = jnp.where(live[:, None], nl, prev_logits)
+
+            # Cache fixups: invalidate rejected window slots (the next
+            # window starts at off+e and always covers them) and advance
+            # lengths by the ACCEPTED count, not the window width.
+            slot = jnp.arange(cache_len, dtype=jnp.int32)[None, :]
+            wpos = slot - off[:, None]
+            in_win = (wpos >= 0) & (wpos < S)
+            fixed_valid = nc.key_valid & ~(in_win & (wpos >= e[:, None]))
+            nc = nc.replace(
+                key_valid=fixed_valid, lengths=cache.lengths + e
+            )
+
+            out_len = out_len + e
+            done = done | (live & (eos_first < e)) | (out_len >= max_new)
+            counters = counters + jnp.stack([
+                k * jnp.sum(live, dtype=jnp.int32),
+                jnp.sum(jnp.maximum(e - 1, 0), dtype=jnp.int32),
+                jnp.ones((), jnp.int32),
+            ])
+            out = (step_idx + 1, nc, prev_logits, done, gen, out_len,
+                   counters)
+            if guard:
+                # The whole [B, S, V] verify window must be finite: the
+                # accepted tokens AND the carried next-step logits both
+                # come out of it.
+                out += (carry[7] & masked_finite(logits, live),)
+            return out
+
+        init = (jnp.zeros((), jnp.int32), cache, last_logits, done0, gen0,
+                out_len0, counters0)
+        if guard:
+            init += (masked_finite(last_logits, row_live),)
+            carry_out = jax.lax.while_loop(cond, body, init)
+            return (carry_out[4][:, :max_new], carry_out[5], carry_out[6],
+                    carry_out[7])
+        _, _, _, _, gen, out_len, counters = jax.lax.while_loop(
+            cond, body, init
+        )
+        return gen[:, :max_new], out_len, counters
+
+    return run
+
+
+def build_prefix(cfg, model, *, prefix_len: int):
+    """Compiled forward over the shared prompt prefix [1, Pc] -> per-layer
+    (k, v) arrays [Pc, Hkv, D] every batch row reads (but never copies)."""
+
+    def run(params, tokens):
+        positions = jnp.arange(prefix_len, dtype=jnp.int32)[None, :]
+        cache = init_cache(cfg, 1, prefix_len)
+        _, cache = model.apply(
+            {"params": params}, tokens, positions,
+            jnp.ones((1, prefix_len), jnp.bool_), cache,
+            left_padded=True, last_only=True,
+        )
+        out = []
+        for layer in cache.layers:
+            if cfg.kv_cache_quant:
+                from fairness_llm_tpu.models.transformer import _dequantize_kv
+
+                dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+                out.append((
+                    _dequantize_kv(layer.k, layer.k_scale, dtype)[0],
+                    _dequantize_kv(layer.v, layer.v_scale, dtype)[0],
+                ))
+            else:
+                out.append((layer.k[0], layer.v[0]))
+        return tuple(out)
+
+    return run
+
+
+# -- serving step programs (one dispatch = chunk x fuse steps) -----------------
+
+
+def build_serve_step(cfg, model, sampler: SamplerSettings, pad_id: int,
+                     eos_id: int, *, num_slots: int, chunk: int,
+                     guard: bool, paged: bool, fuse: int = 1):
+    """The serving decode program: the shared greedy loop over the slot
+    pool, composed with a KV-source adapter.
+
+    Contiguous (``paged=False``): released-slot invalidation rides on the
+    program entry's reset mask (rows in ``reset`` lose their key_valid/
+    lengths before any attention can touch them — one program instead of a
+    separate invalidate dispatch per iteration). Paged (``paged=True``):
+    block tables gather into the per-row contiguous view ONCE at entry,
+    the exact same loop runs, and the private blocks scatter back once at
+    exit — shared prefix entries' write-table slots drop, so two rows
+    sharing a prefix stream one copy of its KV bytes per gather. No reset
+    mask rides the paged program: a released BLOCK re-enters tables only
+    through a prefill that cleared its ``key_valid`` first.
+
+    ``fuse=k`` multiplies the dispatch's step budget to ``chunk * k`` —
+    per-row caps, EOS stops, live masks, and write offsets all advance
+    in-program (they already did), so k chunks' worth of decoding returns
+    to the host in ONE call and the per-dispatch host gap amortizes 1/k.
+    Eviction/backfill and every host-side poll (drain, breaker, watchdog)
+    move to the fused-dispatch boundary; the loop still early-exits the
+    moment every live row finishes, so a fused dispatch never burns steps
+    a plain one wouldn't.
+    """
+    sample = make_sampler(sampler)
+    B = num_slots
+    T = chunk * max(1, fuse)
+    loop = make_greedy_loop(model, sample, pad_id, eos_id, batch=B,
+                            steps=T, guard=guard, per_row_offsets=True)
+
+    if paged:
+        from fairness_llm_tpu.serving.paged import gather_view, scatter_view
+
+        def run(params, arena, prev_logits, tables, wtables, row_seeds,
+                emitted0, base, caps, live0):
+            cache = gather_view(arena, tables, arena.lengths)
+            c = loop(params, cache, prev_logits, row_seeds, emitted0, base,
+                     caps, live0, None)
+            cache = c[1]
+            arena = scatter_view(arena, cache, wtables)
+            arena = arena.replace(lengths=cache.lengths)
+            if guard:
+                return arena, c[2], c[5], c[4], c[6], c[7]
+            return arena, c[2], c[5], c[4], c[6]
+
+        return run
+
+    def run(params, cache, prev_logits, row_seeds, emitted0, base, caps,
+            live0, reset):
+        # Fold released-slot invalidation into the step entry: rows in
+        # ``reset`` lose their key_valid/lengths before any attention can
+        # touch them.
+        keep = ~reset
+        cache = cache.replace(
+            key_valid=cache.key_valid & keep[:, None],
+            lengths=cache.lengths * keep.astype(cache.lengths.dtype),
+        )
+        c = loop(params, cache, prev_logits, row_seeds, emitted0, base,
+                 caps, live0, None)
+        if guard:
+            return c[1], c[2], c[5], c[4], c[6], c[7]
+        return c[1], c[2], c[5], c[4], c[6]
+
+    return run
+
+
+# -- serving prefill programs --------------------------------------------------
+
+
+def build_serve_prefill(cfg, model, *, nb: int, P: int, guard: bool,
+                        num_slots: int):
+    """[nb, P] prompt prefill + row scatter into the shared cache.
+
+    Numerically the engine's batch entry: left-padded tokens, positions
+    from the valid cumsum, ``last_only`` logits. The fresh [nb, P] cache's
+    post-write rows (k/v/key_valid/key_positions/lengths) scatter into the
+    big cache at ``slots``; slots >= num_slots (batch-bucket pad rows)
+    drop. Rows' tail slots [P, cache_len) are re-invalidated here, so a
+    recycled slot never exposes its previous tenant's keys.
+    """
+    masked_finite = _masked_finite() if guard else None
+
+    def run(params, cache, prev_logits, tokens, valid, slots):
+        positions = jnp.maximum(
+            jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
+        )
+        small = init_cache(cfg, nb, P)
+        logits, small = model.apply(
+            {"params": params}, tokens, positions, valid, small,
+            left_padded=True, last_only=True,
+        )
+
+        def scat(big, rows):
+            return big.at[slots, :P].set(rows, mode="drop")
+
+        new_layers = []
+        for bl, sl in zip(cache.layers, small.layers):
+            kw = dict(k=scat(bl.k, sl.k), v=scat(bl.v, sl.v))
+            if bl.k_scale is not None:
+                kw.update(
+                    k_scale=scat(bl.k_scale, sl.k_scale),
+                    v_scale=scat(bl.v_scale, sl.v_scale),
+                )
+            new_layers.append(LayerCache(**kw))
+        key_valid = scat(cache.key_valid, small.key_valid)
+        key_valid = key_valid.at[slots, P:].set(False, mode="drop")
+        new_cache = cache.replace(
+            layers=tuple(new_layers),
+            key_valid=key_valid,
+            key_positions=scat(cache.key_positions, small.key_positions),
+            lengths=cache.lengths.at[slots].set(
+                small.lengths, mode="drop"
+            ),
+        )
+        new_logits = prev_logits.at[slots].set(
+            logits[:, -1, :], mode="drop"
+        )
+        if guard:
+            # Real admissions only (batch-bucket pad rows scatter-drop
+            # and may hold anything): one reduced flag for the batch.
+            return new_cache, new_logits, masked_finite(
+                logits[:, -1, :], slots < num_slots
+            )
+        return new_cache, new_logits
+
+    return run
+
+
+def build_paged_prefill(model, *, nb: int, S: int, guard: bool,
+                        num_slots: int):
+    """[nb, S] SUFFIX prefill through block tables (--paged-kv).
+
+    Each row's cached prefix (``matched`` tokens: full shared blocks + the
+    copy-on-write lead of one partially-shared block) is already in the
+    arena; this program:
+
+    1. copies the CoW source block into the row's private divergence block
+       (the shared source is never mutated),
+    2. clears ``key_valid`` for EVERY private block in the batch's write
+       tables — the block-granularity invalidation discipline: a recycled
+       block is unreadable before its new tenant's writes,
+    3. gathers each row's table into a contiguous view whose validity is
+       constructed as ``position < matched`` (prefix visible, everything
+       else dark),
+    4. forwards the right-padded suffix with per-row ``write_offsets =
+       matched`` — the speculative-verify causal window: suffix query i
+       sees cached slot j iff j <= matched + i, which is exactly "the
+       whole prefix plus my own earlier suffix",
+    5. scatters the view back through the write tables (shared entries
+       drop) and lands each row's LAST-REAL-TOKEN logits in the carried
+       sampler state.
+
+    Numerically this is the engine's forward over the same token content
+    at the same positions — parity with the non-paged path is pinned in
+    tests/test_paged_kv.py.
+    """
+    from fairness_llm_tpu.serving.paged import gather_view, scatter_view
+
+    masked_finite = _masked_finite() if guard else None
+
+    def run(params, arena, prev_logits, tokens, valid, positions,
+            tables, wtables, cow_src, cow_dst, matched, slots, last_idx):
+        def cp(big):
+            # Out-of-range cow_dst drops (no-CoW rows); out-of-range
+            # cow_src clamps on the gather, harmless under the drop.
+            return big.at[cow_dst].set(big[cow_src], mode="drop")
+
+        new_layers = []
+        for lc in arena.layers:
+            kw = dict(k=cp(lc.k), v=cp(lc.v))
+            if lc.k_scale is not None:
+                kw.update(k_scale=cp(lc.k_scale), v_scale=cp(lc.v_scale))
+            new_layers.append(LayerCache(**kw))
+        arena = arena.replace(
+            layers=tuple(new_layers),
+            key_positions=cp(arena.key_positions),
+            key_valid=arena.key_valid.at[wtables].set(False, mode="drop"),
+        )
+        view = gather_view(arena, tables, matched)
+        L = view.key_valid.shape[1]
+        view = view.replace(
+            key_valid=jnp.arange(L)[None, :] < matched[:, None]
+        )
+        logits, view = model.apply(
+            {"params": params}, tokens, positions, valid, view,
+            write_offsets=matched,
+        )
+        last = jnp.take_along_axis(
+            logits, last_idx[:, None, None], axis=1
+        )[:, 0, :]
+        arena = scatter_view(arena, view, wtables)
+        arena = arena.replace(
+            lengths=arena.lengths.at[slots].set(view.lengths, mode="drop")
+        )
+        new_logits = prev_logits.at[slots].set(last, mode="drop")
+        if guard:
+            return arena, new_logits, masked_finite(
+                last, slots < num_slots
+            )
+        return arena, new_logits
+
+    return run

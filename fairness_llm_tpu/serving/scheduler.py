@@ -83,7 +83,7 @@ from fairness_llm_tpu.config import (
     ServingConfig,
 )
 from fairness_llm_tpu.models.tokenizer import _left_pad
-from fairness_llm_tpu.models.transformer import LayerCache, init_cache
+from fairness_llm_tpu.models.transformer import init_cache
 from fairness_llm_tpu.resilience.breaker import BreakerBoard
 from fairness_llm_tpu.resilience.drain import (
     ServingJournal,
@@ -91,7 +91,14 @@ from fairness_llm_tpu.resilience.drain import (
     take_signal_telemetry,
 )
 from fairness_llm_tpu.resilience.watchdog import StepWatchdog
-from fairness_llm_tpu.runtime.sampling import SamplerSettings, make_sampler
+from fairness_llm_tpu.runtime.sampling import SamplerSettings
+from fairness_llm_tpu.runtime.stepbuilder import (
+    build_paged_prefill,
+    build_serve_prefill,
+    build_serve_step,
+    compile_key,
+    program_label,
+)
 from fairness_llm_tpu.serving.overload import (
     DeadlineEstimator,
     ShedController,
@@ -104,12 +111,7 @@ from fairness_llm_tpu.serving.request import (
     Request,
     Result,
 )
-from fairness_llm_tpu.serving.paged import (
-    PagedKV,
-    gather_view,
-    init_arena,
-    scatter_view,
-)
+from fairness_llm_tpu.serving.paged import PagedKV, init_arena
 from fairness_llm_tpu.serving.slots import SlotPool, SlotState
 from fairness_llm_tpu.telemetry import (
     Heartbeat,
@@ -124,7 +126,7 @@ from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
 from fairness_llm_tpu.telemetry.incidents import maybe_trigger, record_decision
 from fairness_llm_tpu.telemetry.roofline import observe_decode
 from fairness_llm_tpu.telemetry.timeline import get_timeline
-from fairness_llm_tpu.integrity.numerics import check_finite, masked_finite
+from fairness_llm_tpu.integrity.numerics import check_finite
 from fairness_llm_tpu.utils.failures import (
     DecodeFault,
     HangFault,
@@ -297,6 +299,16 @@ class ContinuousScheduler:
         # amortize per-call dispatch overhead; smaller chunks backfill
         # freed slots sooner.
         self.decode_chunk = max(1, self.serving.decode_chunk)
+        # fuse_steps (ISSUE 14): decode chunks folded into ONE compiled
+        # dispatch — the step program runs decode_chunk x fuse_steps steps
+        # before returning to the host, so the per-dispatch host gap
+        # (eviction sweep, queue polls, telemetry, the device_get sync)
+        # amortizes 1/fuse per token. Per-row caps/EOS stops advance
+        # in-program (and the loop early-exits when every live row
+        # finishes), so the token stream is identical at any fuse factor;
+        # what moves to the fused boundary is eviction/backfill latency and
+        # every host-side poll (drain, breaker feed, watchdog observe).
+        self.fuse_steps = max(1, getattr(self.serving, "fuse_steps", 1))
         # Request-lifecycle tracing (telemetry/tracing.py): every request's
         # submitted -> admitted -> prefill_start -> first_token -> terminal
         # timeline, feeding the queue-wait/TTFT/per-token/e2e histograms in
@@ -337,6 +349,7 @@ class ContinuousScheduler:
         # Degradation-ladder state: rung 2 halves the decode chunk and
         # soft-caps concurrent slots; both restore when the ladder retreats.
         self._base_decode_chunk = self.decode_chunk
+        self._base_fuse_steps = self.fuse_steps
         self.live_cap = self.num_slots
         self._applied_level = 0
 
@@ -357,68 +370,37 @@ class ContinuousScheduler:
         guarded programs return an extra finite flag."""
         return bool(getattr(self.engine, "numerics_guards", False))
 
-    def _prefill_fn(self, nb: int, P: int, guard: bool):
-        """[nb, P] prompt prefill + row scatter into the shared cache.
+    def _step_key(self, guard: bool) -> tuple:
+        """This scheduler's CURRENT decode-program key: paged-ness via the
+        base name, the mutable ``decode_chunk`` (the degradation ladder can
+        change it mid-run — a halved chunk compiles its own program and
+        restoring reuses the original), the numerics-guard flag (return
+        arity), and the fuse factor — the one scheme every compiled
+        variant shares (``stepbuilder.compile_key``)."""
+        return compile_key("paged_step" if self.paged else "serve_step",
+                           chunk=self.decode_chunk, guard=guard,
+                           fuse=self.fuse_steps)
 
-        Numerically the engine's prefill: left-padded tokens, positions from
-        the valid cumsum, ``last_only`` logits. The fresh [nb, P] cache's
-        post-write rows (k/v/key_valid/key_positions/lengths) scatter into
-        the big cache at ``slots``; slots >= num_slots (batch-bucket pad
-        rows) drop. Rows' tail slots [P, cache_len) are re-invalidated here,
-        so a recycled slot never exposes its previous tenant's keys.
-        """
-        key = ("serve_prefill", nb, P, guard)
+    def _step_program(self) -> str:
+        """Telemetry label for the current decode program: fused dispatches
+        publish their own compile stats / ledger / roofline gauges under
+        ``<base>_fused`` (``validate_telemetry`` holds them to that)."""
+        return program_label("paged_step" if self.paged else "serve_step",
+                             self.fuse_steps)
+
+    def _prefill_fn(self, nb: int, P: int, guard: bool):
+        """[nb, P] prompt prefill + row scatter into the shared cache — the
+        builder's ``serve_prefill`` composition (see
+        ``stepbuilder.build_serve_prefill`` for the program semantics)."""
+        key = compile_key("serve_prefill", nb=nb, P=P, guard=guard)
         fn = self._compiled.get(key)
         note_lookup("serve_prefill", hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
-        cfg = self.engine.config
-        model = self.engine.model
-        num_slots = self.num_slots
-
-        def run(params, cache, prev_logits, tokens, valid, slots):
-            positions = jnp.maximum(
-                jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1, 0
-            )
-            small = init_cache(cfg, nb, P)
-            logits, small = model.apply(
-                {"params": params}, tokens, positions, valid, small,
-                left_padded=True, last_only=True,
-            )
-
-            def scat(big, rows):
-                return big.at[slots, :P].set(rows, mode="drop")
-
-            new_layers = []
-            for bl, sl in zip(cache.layers, small.layers):
-                kw = dict(k=scat(bl.k, sl.k), v=scat(bl.v, sl.v))
-                if bl.k_scale is not None:
-                    kw.update(
-                        k_scale=scat(bl.k_scale, sl.k_scale),
-                        v_scale=scat(bl.v_scale, sl.v_scale),
-                    )
-                new_layers.append(LayerCache(**kw))
-            key_valid = scat(cache.key_valid, small.key_valid)
-            key_valid = key_valid.at[slots, P:].set(False, mode="drop")
-            new_cache = cache.replace(
-                layers=tuple(new_layers),
-                key_valid=key_valid,
-                key_positions=scat(cache.key_positions, small.key_positions),
-                lengths=cache.lengths.at[slots].set(
-                    small.lengths, mode="drop"
-                ),
-            )
-            new_logits = prev_logits.at[slots].set(
-                logits[:, -1, :], mode="drop"
-            )
-            if guard:
-                # Real admissions only (batch-bucket pad rows scatter-drop
-                # and may hold anything): one reduced flag for the batch.
-                return new_cache, new_logits, masked_finite(
-                    logits[:, -1, :], slots < num_slots
-                )
-            return new_cache, new_logits
-
+        run = build_serve_prefill(
+            self.engine.config, self.engine.model, nb=nb, P=P, guard=guard,
+            num_slots=self.num_slots,
+        )
         # No donation here even on TPU: a prefill failure must leave the
         # OTHER live slots' cache rows intact, and a donated input buffer
         # doesn't survive a raised call. instrument_jit = jax.jit + the cost
@@ -428,260 +410,47 @@ class ContinuousScheduler:
         return fn
 
     def _step_fn(self):
-        """The decode program: ``decode_chunk`` steps in one while_loop.
-
-        Mirrors the engine's decode body per iteration — sample from the
-        carried logits with the row's own fold_in(emitted) key, forward one
-        token with per-row ``write_offsets = base + emitted``, carry the new
-        logits — but over the slot pool, with per-row stop conditions
-        (EOS or the row's own budget) instead of a batch-uniform cap. Early
-        exit when every live row finishes mid-chunk.
-        """
-        # The chunk length is baked into the compiled while_loop, and the
-        # degradation ladder can change it mid-run — key on it so a halved
-        # chunk compiles its own program and restoring reuses the original.
-        # The numerics-guard flag changes the return arity, so it keys too.
+        """The decode program: ``decode_chunk x fuse_steps`` steps in one
+        while_loop — the builder's shared greedy loop composed with this
+        scheduler's KV source (contiguous reset-mask entry, or paged
+        gather/scatter). See ``stepbuilder.build_serve_step``."""
         guard = self._guard()
-        key = ("serve_step", self.decode_chunk, guard)
+        key = self._step_key(guard)
+        program = self._step_program()
         fn = self._compiled.get(key)
-        note_lookup("serve_step", hit=fn is not None, labels=self.labels)
+        note_lookup(program, hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
-        cfg = self.engine.config
-        model = self.engine.model
-        sample = make_sampler(self.sampler)
-        pad_id = self.engine.tokenizer.pad_id
-        eos_id = self.engine.tokenizer.eos_id
-        B, T = self.num_slots, self.decode_chunk
-
-        def run(params, cache, prev_logits, row_seeds, emitted0, base, caps,
-                live0, reset):
-            # Fold released-slot invalidation into the step entry (one
-            # program instead of a separate invalidate dispatch + cache
-            # copy per iteration): rows in ``reset`` lose their key_valid/
-            # lengths before any attention can touch them.
-            keep = ~reset
-            cache = cache.replace(
-                key_valid=cache.key_valid & keep[:, None],
-                lengths=cache.lengths * keep.astype(cache.lengths.dtype),
-            )
-            row_keys = jax.vmap(jax.random.key)(row_seeds)
-            toks0 = jnp.full((B, T), pad_id, jnp.int32)
-            done0 = ~live0
-            counters0 = jnp.zeros((2,), jnp.int32)  # steps, live-row-steps
-
-            def cond(carry):
-                t, done = carry[0], carry[3]
-                return (t < T) & ~jnp.all(done)
-
-            def body(carry):
-                t, cache, prev_logits, done, emitted, toks, counters = \
-                    carry[:7]
-                live = ~done
-                step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
-                tok = sample(prev_logits, step_keys)
-                tok = jnp.where(live, tok, pad_id)
-                toks = jax.lax.dynamic_update_slice(
-                    toks, tok[:, None], (jnp.zeros((), jnp.int32), t)
-                )
-                offs = base + emitted
-                pos = cache.lengths[:, None]
-                logits, cache = model.apply(
-                    {"params": params}, tok[:, None], pos, live[:, None],
-                    cache, write_offsets=offs,
-                )
-                prev_logits = jnp.where(
-                    live[:, None], logits[:, -1, :], prev_logits
-                )
-                emitted = emitted + live.astype(jnp.int32)
-                done = done | (tok == eos_id) | (emitted >= caps)
-                counters = counters + jnp.stack(
-                    [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
-                )
-                out = (t + 1, cache, prev_logits, done, emitted, toks,
-                       counters)
-                if guard:
-                    out += (carry[7] & masked_finite(logits[:, -1, :], live),)
-                return out
-
-            init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
-                    emitted0, toks0, counters0)
-            if guard:
-                # Entry check covers the CARRIED logits (the sample source —
-                # where host-side NaN injection, and a poisoned prefill that
-                # slipped a disabled guard, would sit). Live rows only:
-                # released slots legitimately carry stale garbage.
-                init += (masked_finite(prev_logits, live0),)
-                c = jax.lax.while_loop(cond, body, init)
-                return c[1], c[2], c[5], c[4], c[6], c[7]
-            _, cache, prev_logits, _, emitted, toks, counters = \
-                jax.lax.while_loop(cond, body, init)
-            return cache, prev_logits, toks, emitted, counters
-
-        fn = instrument_jit(run, "serve_step", donate_argnums=self._donate())
+        run = build_serve_step(
+            self.engine.config, self.engine.model, self.sampler,
+            self.engine.tokenizer.pad_id, self.engine.tokenizer.eos_id,
+            num_slots=self.num_slots, chunk=self.decode_chunk, guard=guard,
+            paged=self.paged, fuse=self.fuse_steps,
+        )
+        fn = instrument_jit(run, program, donate_argnums=self._donate())
         self._compiled[key] = fn
         return fn
 
     def _paged_prefill_fn(self, nb: int, S: int, guard: bool):
-        """[nb, S] SUFFIX prefill through block tables (--paged-kv).
-
-        Each row's cached prefix (``matched`` tokens: full shared blocks +
-        the copy-on-write lead of one partially-shared block) is already in
-        the arena; this program:
-
-        1. copies the CoW source block into the row's private divergence
-           block (the shared source is never mutated),
-        2. clears ``key_valid`` for EVERY private block in the batch's
-           write tables — the block-granularity invalidation discipline: a
-           recycled block is unreadable before its new tenant's writes,
-        3. gathers each row's table into a contiguous view whose validity
-           is constructed as ``position < matched`` (prefix visible,
-           everything else dark),
-        4. forwards the right-padded suffix with per-row
-           ``write_offsets = matched`` — the speculative-verify causal
-           window: suffix query i sees cached slot j iff j <= matched + i,
-           which is exactly "the whole prefix plus my own earlier suffix",
-        5. scatters the view back through the write tables (shared entries
-           drop) and lands each row's LAST-REAL-TOKEN logits in the carried
-           sampler state.
-
-        Numerically this is the engine's forward over the same token
-        content at the same positions — parity with the non-paged path is
-        pinned in tests/test_paged_kv.py.
+        """[nb, S] SUFFIX prefill through block tables (--paged-kv) — the
+        builder's ``paged_prefill`` composition: CoW copy, private-block
+        invalidation, gather to a contiguous view, suffix forward with
+        ``write_offsets = matched``, scatter back. See
+        ``stepbuilder.build_paged_prefill`` for the program semantics;
+        parity with the non-paged path is pinned in tests/test_paged_kv.py.
         """
-        key = ("paged_prefill", nb, S, guard)
+        key = compile_key("paged_prefill", nb=nb, P=S, guard=guard)
         fn = self._compiled.get(key)
         note_lookup("paged_prefill", hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
-        model = self.engine.model
-        num_slots = self.num_slots
-
-        def run(params, arena, prev_logits, tokens, valid, positions,
-                tables, wtables, cow_src, cow_dst, matched, slots, last_idx):
-            def cp(big):
-                # Out-of-range cow_dst drops (no-CoW rows); out-of-range
-                # cow_src clamps on the gather, harmless under the drop.
-                return big.at[cow_dst].set(big[cow_src], mode="drop")
-
-            new_layers = []
-            for lc in arena.layers:
-                kw = dict(k=cp(lc.k), v=cp(lc.v))
-                if lc.k_scale is not None:
-                    kw.update(k_scale=cp(lc.k_scale), v_scale=cp(lc.v_scale))
-                new_layers.append(LayerCache(**kw))
-            arena = arena.replace(
-                layers=tuple(new_layers),
-                key_positions=cp(arena.key_positions),
-                key_valid=arena.key_valid.at[wtables].set(False, mode="drop"),
-            )
-            view = gather_view(arena, tables, matched)
-            L = view.key_valid.shape[1]
-            view = view.replace(
-                key_valid=jnp.arange(L)[None, :] < matched[:, None]
-            )
-            logits, view = model.apply(
-                {"params": params}, tokens, positions, valid, view,
-                write_offsets=matched,
-            )
-            last = jnp.take_along_axis(
-                logits, last_idx[:, None, None], axis=1
-            )[:, 0, :]
-            arena = scatter_view(arena, view, wtables)
-            arena = arena.replace(
-                lengths=arena.lengths.at[slots].set(view.lengths, mode="drop")
-            )
-            new_logits = prev_logits.at[slots].set(last, mode="drop")
-            if guard:
-                return arena, new_logits, masked_finite(
-                    last, slots < num_slots
-                )
-            return arena, new_logits
-
+        run = build_paged_prefill(
+            self.engine.model, nb=nb, S=S, guard=guard,
+            num_slots=self.num_slots,
+        )
         # Not donated, like the plain prefill: a raised call must leave the
         # other live slots' arena blocks intact.
         fn = instrument_jit(run, "paged_prefill")
-        self._compiled[key] = fn
-        return fn
-
-    def _paged_step_fn(self):
-        """The paged decode program: gather block tables into the per-row
-        contiguous view ONCE, run the exact same ``decode_chunk`` while_loop
-        the private-row program runs (same sampler streams, same per-row
-        write offsets and stop conditions), scatter the private blocks back
-        once at chunk exit. Shared prefix blocks are read-only by
-        construction (their write-table entries drop), so two rows sharing
-        a prefix stream one copy of its KV bytes from the arena per gather.
-        No reset mask rides this program — released blocks re-enter tables
-        only through a prefill that cleared their ``key_valid`` first."""
-        guard = self._guard()
-        key = ("paged_step", self.decode_chunk, guard)
-        fn = self._compiled.get(key)
-        note_lookup("paged_step", hit=fn is not None, labels=self.labels)
-        if fn is not None:
-            return fn
-        cfg = self.engine.config
-        model = self.engine.model
-        sample = make_sampler(self.sampler)
-        pad_id = self.engine.tokenizer.pad_id
-        eos_id = self.engine.tokenizer.eos_id
-        B, T = self.num_slots, self.decode_chunk
-
-        def run(params, arena, prev_logits, tables, wtables, row_seeds,
-                emitted0, base, caps, live0):
-            cache = gather_view(arena, tables, arena.lengths)
-            row_keys = jax.vmap(jax.random.key)(row_seeds)
-            toks0 = jnp.full((B, T), pad_id, jnp.int32)
-            done0 = ~live0
-            counters0 = jnp.zeros((2,), jnp.int32)
-
-            def cond(carry):
-                t, done = carry[0], carry[3]
-                return (t < T) & ~jnp.all(done)
-
-            def body(carry):
-                t, cache, prev_logits, done, emitted, toks, counters = \
-                    carry[:7]
-                live = ~done
-                step_keys = jax.vmap(jax.random.fold_in)(row_keys, emitted)
-                tok = sample(prev_logits, step_keys)
-                tok = jnp.where(live, tok, pad_id)
-                toks = jax.lax.dynamic_update_slice(
-                    toks, tok[:, None], (jnp.zeros((), jnp.int32), t)
-                )
-                offs = base + emitted
-                pos = cache.lengths[:, None]
-                logits, cache = model.apply(
-                    {"params": params}, tok[:, None], pos, live[:, None],
-                    cache, write_offsets=offs,
-                )
-                prev_logits = jnp.where(
-                    live[:, None], logits[:, -1, :], prev_logits
-                )
-                emitted = emitted + live.astype(jnp.int32)
-                done = done | (tok == eos_id) | (emitted >= caps)
-                counters = counters + jnp.stack(
-                    [jnp.ones((), jnp.int32), jnp.sum(live, dtype=jnp.int32)]
-                )
-                out = (t + 1, cache, prev_logits, done, emitted, toks,
-                       counters)
-                if guard:
-                    out += (carry[7] & masked_finite(logits[:, -1, :], live),)
-                return out
-
-            init = (jnp.zeros((), jnp.int32), cache, prev_logits, done0,
-                    emitted0, toks0, counters0)
-            if guard:
-                init += (masked_finite(prev_logits, live0),)
-            c = jax.lax.while_loop(cond, body, init)
-            cache = c[1]
-            arena = scatter_view(arena, cache, wtables)
-            arena = arena.replace(lengths=cache.lengths)
-            if guard:
-                return arena, c[2], c[5], c[4], c[6], c[7]
-            return arena, c[2], c[5], c[4], c[6]
-
-        fn = instrument_jit(run, "paged_step", donate_argnums=self._donate())
         self._compiled[key] = fn
         return fn
 
@@ -918,15 +687,20 @@ class ContinuousScheduler:
         if lvl >= 2:
             self.decode_chunk = max(1, self._base_decode_chunk // 2)
             self.live_cap = max(1, self.num_slots // 2)
+            # Fused dispatch is a pure-throughput feature with a chunk-wide
+            # blast radius (one fault discards fuse x chunk steps of work)
+            # — rung 2's smaller-compiled-steps posture drops it to 1.
+            self.fuse_steps = 1
         else:
             self.decode_chunk = self._base_decode_chunk
             self.live_cap = self.num_slots
+            self.fuse_steps = self._base_fuse_steps
         logger.warning(
             "degradation rung %d (%s) applied: speculation=%s "
-            "decode_chunk=%d live_cap=%d",
+            "decode_chunk=%d fuse_steps=%d live_cap=%d",
             lvl, self.breakers.ladder.rung,
             "shed" if self.engine._spec_shed else "kept",
-            self.decode_chunk, self.live_cap,
+            self.decode_chunk, self.fuse_steps, self.live_cap,
         )
         self._applied_level = lvl
 
@@ -1134,8 +908,11 @@ class ContinuousScheduler:
                 )
             else:
                 ahead = len(self.queue)
+            # Slot turnover happens at the fused-dispatch boundary, so the
+            # feasibility wave is decode_chunk x fuse_steps steps wide.
             est = self.deadline_estimator.infeasible(
-                request, ahead, self.num_slots, self.decode_chunk,
+                request, ahead, self.num_slots,
+                self.decode_chunk * self.fuse_steps,
             )
             if est is not None:
                 self._shed(
@@ -1309,7 +1086,8 @@ class ContinuousScheduler:
                 # cover one prefill + one decode step sheds HERE instead of
                 # burning a full prefill and expiring mid-decode.
                 est = self.deadline_estimator.infeasible(
-                    req, 0, self.num_slots, self.decode_chunk, now=now,
+                    req, 0, self.num_slots,
+                    self.decode_chunk * self.fuse_steps, now=now,
                 )
                 if est is not None:
                     self._shed(
@@ -1722,8 +1500,8 @@ class ContinuousScheduler:
             seed = st.request.row_seed
             seeds[slot] = np.uint32((0 if seed is None else seed) & 0xFFFFFFFF)
         guard = self._guard()
-        step_key = (("paged_step" if self.paged else "serve_step"),
-                    self.decode_chunk, guard)
+        step_key = self._step_key(guard)
+        prog = self._step_program()
         first_compile = step_key not in self._compiled
         if self.paged:
             paged = self.pool.paged
@@ -1733,9 +1511,7 @@ class ContinuousScheduler:
             for slot in live_ids:
                 tables[slot] = paged.table_for(slot)
                 wtables[slot] = paged.write_table_for(slot)
-            fn = self._paged_step_fn()
-        else:
-            fn = self._step_fn()
+        fn = self._step_fn()
         dc_t0 = time.monotonic()
         if self.watchdog is not None:
             self.watchdog.arm("decode")
@@ -1781,8 +1557,13 @@ class ContinuousScheduler:
                 # below — its tokens are discarded and every rider requeues
                 # for a fresh attempt, exactly like a failed chunk (a hung
                 # step's outputs are unaccounted time, not trusted work).
+                # A fused dispatch legitimately runs fuse_steps chunks of
+                # wall, so the budget scales with it — a threshold tuned
+                # for one chunk must not classify every healthy fused
+                # dispatch as a hang.
                 self.watchdog.observe("decode", extra_s=injected_hang,
-                                      classify=not first_compile)
+                                      classify=not first_compile,
+                                      budget_scale=self.fuse_steps)
         except Exception as e:  # noqa: BLE001 — containment is the point
             kind = ("hang" if isinstance(e, HangFault)
                     else "numerics" if isinstance(e, NumericsFault)
@@ -1839,19 +1620,19 @@ class ContinuousScheduler:
         gap = get_timeline().decode_chunk(self._track, dc_t0, dc_wall, steps,
                                           labels=self.labels,
                                           rows=len(live_ids),
-                                          program=step_key[0])
+                                          program=prog)
         # Flight-recorder chunk ring (telemetry/flightrecorder.py): the
         # last-K decode chunks with their step gaps — the high-rate recent
         # history an incident bundle snapshots but nothing persists.
         get_flight_recorder().record(
-            "chunks", program=step_key[0], steps=steps,
+            "chunks", program=prog, steps=steps,
             wall_s=round(dc_wall, 6),
             gap_s=(round(gap, 6) if gap is not None else None),
             rows=len(live_ids), replica=self.replica, t=dc_t0,
         )
         if first_compile:
             record_compile(
-                step_key[0],
+                prog,
                 reason=("decode_chunk"
                         if self.decode_chunk != self._base_decode_chunk
                         else "shape"),
@@ -1869,13 +1650,13 @@ class ContinuousScheduler:
             roof_stats.update(paged_kv=True, chunk_steps=steps)
         observe_decode(
             self.engine.config, roof_stats,
-            steps, dc_wall, program=step_key[0], labels=self.labels,
+            steps, dc_wall, program=prog, labels=self.labels,
         )
         # Gap attribution (telemetry/costmodel.py): the chunk's measured
         # wall + trip count against the step program's analytic ledger. A
         # first-compile chunk's wall is tagged so the decomposition shows
         # compile as its own contributor, not "unattributed in-step".
-        note_invocation(step_key[0], dc_wall, steps,
+        note_invocation(prog, dc_wall, steps,
                         ledger=getattr(fn, "ledger", None),
                         compiling=first_compile)
         # Per-chunk pool-pressure samples, weighted by the steps the chunk

@@ -73,8 +73,9 @@ def decode_step_bytes(config, stats) -> int:
     prefix-cache win). ``stats`` carries ``batch`` / ``cache_slots`` /
     ``prefix_len`` (the ``GenerateOutput.stats`` shape).
 
-    Paged KV (``--paged-kv``, serving/paged.py): ``_paged_step_fn`` runs
-    the same per-step while_loop over a CONTIGUOUS view it gathers from
+    Paged KV (``--paged-kv``, serving/paged.py): the ``paged_step``
+    program (``stepbuilder.build_serve_step(paged=True)``) runs the same
+    per-step while_loop over a CONTIGUOUS view it gathers from
     the block arena once per chunk and scatters back once per chunk —
     traffic the contiguous-layout model omits, understating achieved
     bandwidth. With ``stats["paged_kv"]`` true, the per-chunk copies are

@@ -1,0 +1,382 @@
+"""Step-program builder parity harness (ISSUE 14).
+
+The correctness contract for ``runtime/stepbuilder.py`` is that every
+composition the builder emits — across the four axes it exposes — decodes
+token-for-token what ``DecodeEngine.generate`` decodes for the same prompt
+alone:
+
+    {contiguous, paged} x {greedy, spec-verify} x {guards on, off}
+                        x {fuse 1, 2, 4}    (where legal)
+
+Illegal cells are structural, not skipped-for-time: spec-verify is an
+engine-path selection (the serving scheduler is greedy/sampled per-row),
+paged KV is a serving-path KV source, and fuse composes only with the
+serving dispatch (the engine's whole generation is already one dispatch).
+
+On top of the grid: recycled-slot, requeue-after-fault, and fleet-migration
+parity for FUSED serving (the chunk boundary moved — the containment and
+migration machinery must not care), the one compile-key scheme's pinned
+layout, the fused-vs-unfused roofline byte oracle, fused telemetry
+attribution (a fused program publishes under its own label), the
+degradation ladder's fuse reset, and the CLI flag gates.
+"""
+
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import (
+    FleetConfig,
+    IntegrityConfig,
+    ModelSettings,
+    ResilienceConfig,
+    ServingConfig,
+    SpeculationConfig,
+)
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.runtime.sampling import SamplerSettings
+from fairness_llm_tpu.runtime.stepbuilder import (
+    STEP_PROGRAMS,
+    compile_key,
+    program_label,
+)
+from fairness_llm_tpu.serving.fleet import ReplicaSet
+from fairness_llm_tpu.serving.request import Request
+from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
+from fairness_llm_tpu.telemetry import use_registry
+from fairness_llm_tpu.telemetry.roofline import decode_step_bytes
+from fairness_llm_tpu.telemetry.timeline import set_attribution, use_timeline
+from fairness_llm_tpu.utils.failures import ScriptedFaultInjector
+
+
+def greedy(m: int) -> ModelSettings:
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+# A near-duplicate family (shared prefix for the paged radix index) plus
+# genuinely mixed-length odd prompts, enough of them that a 2-slot pool
+# recycles every slot several times per serve.
+PROMPTS = [
+    "recommend movies for a user who likes drama and history",
+    "recommend movies for a user who likes drama and comedy",
+    "recommend movies for a user who likes drama and action",
+    "the quick brown fox",
+    "one two three one two three one",
+    "zz zz zz",
+]
+
+M = 8  # tokens per request — enough to cross several chunk boundaries
+
+
+def _scfg(fuse=1, paged=False, slots=2, chunk=2):
+    return ServingConfig(
+        enabled=True, num_slots=slots, queue_capacity=64,
+        max_prompt_len=192, max_new_tokens=32, decode_chunk=chunk,
+        fuse_steps=fuse, paged_kv=paged, kv_block_size=16,
+    )
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+@pytest.fixture(scope="module")
+def baseline(engine):
+    """Per-prompt single-request engine reference — what every builder
+    composition must reproduce token-for-token."""
+    return {p: np.asarray(engine.generate([p], greedy(M)).tokens[0])
+            for p in PROMPTS}
+
+
+def _assert_parity(engine, baseline, requests, results):
+    by_id = {r.id: r for r in results} if isinstance(results, dict) else None
+    for req, res in zip(requests, results if by_id is None else
+                        [by_id[q.id] for q in requests]):
+        assert res.ok, (req.id, res.finish_reason, res.error)
+        got = np.asarray(res.tokens)
+        ref = baseline[req.prompt]
+        n = len(got)
+        assert n > 0 and np.array_equal(got, ref[:n]) \
+            and np.all(ref[n:] == engine.tokenizer.pad_id), \
+            (req.id, list(got), list(ref))
+
+
+# -- the compile-key scheme ----------------------------------------------------
+
+
+def test_compile_key_scheme_layout():
+    """The pinned layout invariants: key[0] is the program (the speculation
+    slot), the guard flag closes ``decode`` keys and sits mid-key on
+    ``spec_decode`` (trailing pair = the speculation knobs), step keys
+    carry (chunk, guard, fuse)."""
+    s = SamplerSettings(temperature=0.0)
+    k = compile_key("decode", batch=8, prompt_len=64, max_new=32, sampler=s,
+                    prefix_len=0, guard=True)
+    assert k[0] == "decode" and k[-1] is True
+    k = compile_key("spec_decode", batch=8, prompt_len=64, max_new=32,
+                    prefix_len=0, guard=False, ngram_max=3, draft_len=8)
+    assert k[0] == "spec_decode" and k[-2:] == (3, 8) and k[5] is False
+    assert compile_key("serve_step", chunk=8, guard=False) == \
+        ("serve_step", 8, False, 1)
+    assert compile_key("paged_step", chunk=4, guard=True, fuse=4) == \
+        ("paged_step", 4, True, 4)
+    assert compile_key("serve_prefill", nb=4, P=64, guard=False) == \
+        ("serve_prefill", 4, 64, False)
+    assert compile_key("prefix", prefix_len=128) == ("prefix", 128)
+    with pytest.raises(ValueError):
+        compile_key("warp_drive")
+
+
+def test_program_label_fused_naming():
+    assert program_label("serve_step", 1) == "serve_step"
+    assert program_label("serve_step", 4) == "serve_step_fused"
+    assert program_label("paged_step", 2) == "paged_step_fused"
+    assert set(STEP_PROGRAMS) == {
+        "serve_step", "paged_step", "serve_step_fused", "paged_step_fused"}
+
+
+def test_step_keys_disjoint_across_fuse_and_chunk(engine):
+    """A fused program can never reuse (or be reused by) the per-chunk
+    program: the fuse factor is a compile-key axis, like the mutable
+    decode_chunk the degradation ladder halves."""
+    keys = {compile_key("serve_step", chunk=c, guard=g, fuse=f)
+            for c in (4, 8) for g in (False, True) for f in (1, 2, 4)}
+    assert len(keys) == 12
+
+
+# -- the parity grid -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["contiguous", "paged"])
+@pytest.mark.parametrize("guard", [False, True], ids=["plain", "guarded"])
+@pytest.mark.parametrize("fuse", [1, 2, 4])
+def test_serving_grid_parity(engine, baseline, paged, guard, fuse):
+    """{contiguous, paged} x {guards on, off} x {fuse 1, 2, 4}, greedy
+    selection: 6 mixed requests over 2 slots (every slot recycles), each
+    token-identical to the engine alone. The fused cells are the tentpole's
+    acceptance surface: per-row caps/EOS stops advance in-program, so
+    folding k chunks into one dispatch must not move a single token."""
+    engine.numerics_guards = guard
+    try:
+        sched = ContinuousScheduler(
+            engine, _scfg(fuse=fuse, paged=paged), settings=greedy(M),
+        )
+        reqs = [Request(id=f"g{i}", prompt=p, settings=greedy(M))
+                for i, p in enumerate(PROMPTS)]
+        results = sched.serve(reqs)
+        _assert_parity(engine, baseline, reqs, results)
+        # The dispatched program compiled under the unified key.
+        base = "paged_step" if paged else "serve_step"
+        assert compile_key(base, chunk=2, guard=guard, fuse=fuse) \
+            in sched._compiled
+    finally:
+        engine.numerics_guards = False
+
+
+@pytest.mark.parametrize("guard", [False, True], ids=["plain", "guarded"])
+def test_spec_verify_composition_parity(engine, guard):
+    """The spec-verify selection (engine path): the builder's draft-and-
+    verify composition emits exactly the plain greedy composition's
+    tokens, guards on or off."""
+    spec = SpeculationConfig(enabled=True, draft_len=4, ngram_max=3)
+    engine.numerics_guards = guard
+    try:
+        prompts = PROMPTS[:3]
+        plain = engine.generate(prompts, greedy(16))
+        spec_out = engine.generate(prompts, greedy(16), speculation=spec)
+        np.testing.assert_array_equal(plain.tokens, spec_out.tokens)
+        assert "speculation" in spec_out.stats
+    finally:
+        engine.numerics_guards = False
+
+
+def test_fused_requeue_parity(engine, baseline):
+    """A decode fault inside a FUSED window discards the whole dispatch
+    and requeues every rider once — survivors re-decode token-identical
+    (the containment contract is per dispatch, whatever its width)."""
+    inj = ScriptedFaultInjector({("g1", "decode"): 1})
+    sched = ContinuousScheduler(
+        engine, _scfg(fuse=4), settings=greedy(M), fault_injector=inj,
+    )
+    reqs = [Request(id=f"g{i}", prompt=p, settings=greedy(M))
+            for i, p in enumerate(PROMPTS[:4])]
+    results = sched.serve(reqs)
+    _assert_parity(engine, baseline, reqs, results)
+    assert results[1].retries == 1
+    assert sched.last_stats.requeued == 1
+
+
+def test_fused_numerics_guard_containment(engine, baseline):
+    """Injected NaN inside a fused window: the guard flag rides the fused
+    carry, the whole dispatch is discarded as a NumericsFault at the
+    dispatch boundary, and the requeued rider still decodes to parity —
+    the chaos drill's fused fault case in miniature."""
+    engine.numerics_guards = True
+    try:
+        inj = ScriptedFaultInjector({}, corruptions={("g0", "decode"): 1})
+        sched = ContinuousScheduler(
+            engine, _scfg(fuse=4), settings=greedy(M), fault_injector=inj,
+            resilience=ResilienceConfig(enabled=True),
+        )
+        reqs = [Request(id=f"g{i}", prompt=p, settings=greedy(M))
+                for i, p in enumerate(PROMPTS[:4])]
+        with use_registry() as reg:
+            results = sched.serve(reqs)
+            m = reg.peek("faults_total", component="serving",
+                         kind="numerics", stage="decode")
+            assert m is not None and m.value >= 1
+        _assert_parity(engine, baseline, reqs, results)
+    finally:
+        engine.numerics_guards = False
+
+
+def test_fused_fleet_migration_parity(engine, baseline):
+    """Fleet failover with FUSED replicas: kill r1 mid-sweep — zero lost,
+    migrated survivors token-identical through r0's own fused dispatch."""
+    # Crash on the FIRST health poll: a fused fleet finishes the sweep in
+    # so few loop iterations that a later-scheduled crash would miss it.
+    inj = ScriptedFaultInjector(replica_crashes={"r1": 1})
+    fleet = ReplicaSet(
+        engine, _scfg(fuse=4), settings=greedy(M),
+        fleet=FleetConfig(replicas=2, fence_cooldown_s=0.02),
+        resilience=ResilienceConfig(enabled=True, breaker_threshold=1,
+                                    breaker_cooldown_s=0.01),
+        integrity=IntegrityConfig(canary_max_tokens=8),
+        fault_injector=inj,
+    )
+    reqs = [Request(id=f"g{i}", prompt=p, settings=greedy(M))
+            for i, p in enumerate(PROMPTS)]
+    results = fleet.serve(reqs)
+    _assert_parity(engine, baseline, reqs, results)
+    r0, r1 = fleet.replicas
+    assert r1.fences == 1 and r0.fences == 0
+
+
+def test_watchdog_budget_scales_with_fuse():
+    """A fused dispatch legitimately runs k chunks of wall: a hang budget
+    tuned for one chunk must not classify every healthy fused dispatch as
+    a hang (the scheduler passes budget_scale=fuse_steps), while a stall
+    past the SCALED budget still raises."""
+    from fairness_llm_tpu.resilience.watchdog import StepWatchdog
+    from fairness_llm_tpu.utils.failures import HangFault
+
+    with use_registry():
+        wd = StepWatchdog(0.1)
+        # 5 chunks of wall under fuse=8: healthy, within the scaled budget.
+        assert wd.observe("decode", elapsed=0.5, budget_scale=8) == 0.5
+        # The same wall with no scaling (fuse=1) is a hang.
+        with pytest.raises(HangFault):
+            wd.observe("decode", elapsed=0.5)
+        # A stall past even the scaled budget still classifies.
+        with pytest.raises(HangFault):
+            wd.observe("decode", elapsed=1.0, budget_scale=8)
+
+
+def test_degradation_rung2_resets_fuse(engine):
+    """Rung 2's smaller-compiled-steps posture: the fused dispatch drops
+    to 1 alongside the halved chunk, and both restore on retreat."""
+
+    class _Ladder:
+        level = 2
+        rung = "reduced_footprint"
+
+    class _Board:
+        ladder = _Ladder()
+
+    sched = ContinuousScheduler(engine, _scfg(fuse=4, chunk=8),
+                                settings=greedy(M))
+    sched.breakers = _Board()
+    sched._apply_degradation()
+    assert sched.fuse_steps == 1 and sched.decode_chunk == 4
+    _Ladder.level = 0
+    sched._apply_degradation()
+    assert sched.fuse_steps == 4 and sched.decode_chunk == 8
+    engine.restore_speculation()
+
+
+# -- roofline: the fused byte oracle ------------------------------------------
+
+
+def test_fused_vs_unfused_paged_byte_oracle(engine):
+    """Hand-computed sibling of PR 12's paged oracle: the paged gather/
+    scatter tax amortizes over the steps the dispatch ACTUALLY ran, so a
+    fused dispatch (k x the steps) pays 1/k the per-step paged overhead
+    while the contiguous terms (params + pool KV) are unchanged."""
+    cfg = engine.config
+    item = 2 if cfg.dtype == "bfloat16" else 4
+    params = cfg.approx_param_count * item
+    per_slot = cfg.num_kv_heads * cfg.head_dim * item * 2 * cfg.num_layers
+    kv = 2 * 64 * per_slot  # batch=2 slots, 64 cache slots each
+    base = {"batch": 2, "cache_slots": 64, "prefix_len": 0}
+    plain = decode_step_bytes(cfg, base)
+    assert plain == params + kv
+
+    unfused = decode_step_bytes(
+        cfg, {**base, "paged_kv": True, "chunk_steps": 8})
+    fused = decode_step_bytes(
+        cfg, {**base, "paged_kv": True, "chunk_steps": 32})
+    assert unfused == params + kv + 4 * kv // 8
+    assert fused == params + kv + 4 * kv // 32
+    assert fused < unfused
+    # Contiguous fused steps stream the same bytes per step as unfused:
+    # the fusion win is host-gap amortization, not a byte-model change.
+    assert decode_step_bytes(cfg, base) == plain
+
+
+# -- fused telemetry attribution ----------------------------------------------
+
+
+def test_fused_program_publishes_own_telemetry(engine):
+    """A fused program appearing in compiles_total publishes its OWN cost
+    ledger, roofline gauges, and host-gap accumulator under the
+    ``serve_step_fused`` label — what ``validate_telemetry``'s extended
+    --require-costmodel/--require-profile gates hold it to."""
+    prev = set_attribution(True)
+    try:
+        with use_registry() as reg, use_timeline():
+            sched = ContinuousScheduler(engine, _scfg(fuse=2),
+                                        settings=greedy(M))
+            reqs = [Request(id=f"t{i}", prompt=p, settings=greedy(M))
+                    for i, p in enumerate(PROMPTS)]
+            results = sched.serve(reqs)
+            assert all(r.ok for r in results)
+
+            def rows(name):
+                return [m for m in reg.instruments()
+                        if m.name == name
+                        and m.labels.get("program") == "serve_step_fused"]
+
+            assert any(m.value >= 1 for m in rows("compiles_total"))
+            assert rows("cost_ledger_bytes"), \
+                "fused program must publish its own ledger"
+            assert rows("achieved_over_achievable"), \
+                "fused program must publish its own roofline gauges"
+            gaps = rows("cost_host_gap_s_total")
+            assert gaps and gaps[0].value > 0, \
+                "fused dispatches must accumulate a measured host gap"
+    finally:
+        set_attribution(prev)
+
+
+# -- CLI flag gates ------------------------------------------------------------
+
+
+def test_cli_fuse_steps_validation():
+    from fairness_llm_tpu.cli.main import main
+
+    base = ["--phase", "1", "--quick", "--model", "simulated", "--no-save"]
+    with pytest.raises(SystemExit, match="require --continuous"):
+        main(base + ["--fuse-steps", "4"])
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        main(base + ["--continuous", "--fuse-steps", "0"])
+    with pytest.raises(SystemExit, match="cannot combine with --speculate"):
+        main(base + ["--continuous", "--speculate", "--fuse-steps", "4"])
+
+
+def test_serving_config_fuse_default_is_identity():
+    """fuse_steps=1 is the byte-identical default: same compile key shape,
+    same program label, no fused telemetry names anywhere."""
+    assert ServingConfig().fuse_steps == 1
+    assert program_label("serve_step", ServingConfig().fuse_steps) == \
+        "serve_step"

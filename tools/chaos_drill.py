@@ -52,7 +52,14 @@ What it does, in one process, deterministically:
    block would corrupt a survivor's tokens), the requeue re-admitted
    through the radix index (nonzero hit tokens), and block accounting
    whole at drain;
-10. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
+10. drills the FUSED DISPATCH (ISSUE 14): the same workload through a
+   ``--fuse-steps 4`` scheduler with an injected NaN landing INSIDE a
+   fused window (four chunks in one compiled call) — the guard flag rides
+   the fused carry, the whole dispatch is discarded at its boundary as
+   one ``NumericsFault``, the poisoned rider requeues once, and every
+   survivor decodes token-identical (fusion widens the blast radius per
+   fault, never the outcome);
+11. validates the ISSUE-4/5/6 acceptance: every request terminal (zero
    lost), survivors token-for-token equal to the baseline (zero corrupt
    records — the NaN chunk was retried, not delivered), the breaker cycle
    + hang + numerics fault + manifest failure + canary mismatch + fleet
@@ -657,6 +664,60 @@ def main() -> int:
           "paged chaos: block accounting whole at drain "
           f"(free {pkv.free_blocks} + cached {tree_blocks} "
           f"== {pkv.num_blocks})")
+
+    # 10. FUSED multi-step dispatch under faults (ISSUE 14,
+    # runtime/stepbuilder.py): the same containment contract with the
+    # dispatch boundary MOVED — --fuse-steps 4 folds four decode chunks
+    # into one compiled call, and an injected NaN lands INSIDE that fused
+    # window. The numerics-guard flag rides the fused carry, so the whole
+    # dispatch discards at its boundary as one NumericsFault, every rider
+    # requeues once, and survivors decode token-identical: fusion may
+    # widen the blast radius per fault (k chunks of work), never the
+    # outcome.
+    reg = T.get_registry()
+    nf_before = reg.read_value("numerics_faults_total",
+                               component="serving", stage="decode")
+    fused_cfg = _dc.replace(SERVING, fuse_steps=4)
+    fused_fam = list(PROMPTS.values())[:4]
+    fused_baseline = {
+        f"fused{i}": np.asarray(engine.generate([p], GREEDY).tokens[0])
+        for i, p in enumerate(fused_fam)
+    }
+    fused_inj = ScriptedFaultInjector(
+        {}, corruptions={("fused1", "decode"): 1})
+    fused_sched = ContinuousScheduler(engine, fused_cfg, settings=GREEDY,
+                                      fault_injector=fused_inj,
+                                      resilience=RESILIENCE)
+    fused_res = {r.id: r for r in fused_sched.serve(
+        [Request(prompt=p, id=f"fused{i}", settings=GREEDY)
+         for i, p in enumerate(fused_fam)]
+    )}
+    check(len(fused_res) == len(fused_fam)
+          and all(r.ok for r in fused_res.values()),
+          "fused chaos: zero lost under NaN inside a fused window")
+    fused_parity = all(
+        np.array_equal(np.asarray(r.tokens),
+                       fused_baseline[rid][:len(r.tokens)])
+        and np.all(fused_baseline[rid][len(r.tokens):]
+                   == engine.tokenizer.pad_id)
+        for rid, r in fused_res.items()
+    )
+    check(fused_parity,
+          "fused chaos: survivors token-identical across the moved "
+          "dispatch boundary")
+    check(fused_res["fused1"].retries == 1,
+          "fused chaos: poisoned rider requeued exactly once")
+    nf_after = reg.read_value("numerics_faults_total",
+                              component="serving", stage="decode")
+    check(nf_after > nf_before,
+          "fused chaos: the fused window's NaN classified as a "
+          f"NumericsFault ({nf_before:g} -> {nf_after:g})")
+    from fairness_llm_tpu.runtime.stepbuilder import compile_key as _ck
+
+    check(_ck("serve_step", chunk=SERVING.decode_chunk, guard=True, fuse=4)
+          in fused_sched._compiled,
+          "fused chaos: the dispatch compiled under the fused key "
+          "(chunk, guard, fuse)")
 
     snap = T.snapshot(T.get_registry())
     # Unlabeled entries only: the fleet section's per-replica boards write

@@ -56,7 +56,12 @@ crashed replica rejoined; retired replicas left the gauge entirely).
 every program counted in ``compiles_total`` must have published a nonzero
 ``cost_ledger_bytes`` gauge (the jaxpr-walked analytical bytes per
 component), plus nonzero ``cost_wall_s_total`` accumulation so the
-``perf-report`` gap decomposition is derivable from the snapshot.
+``perf-report`` gap decomposition is derivable from the snapshot. A
+``*_fused`` program (ISSUE 14's fused multi-step dispatch) additionally
+must show its own measured wall AND a nonzero ``cost_host_gap_s_total``
+— the host-gap term fusion exists to shrink must be MEASURED, never
+assumed; ``--require-profile`` likewise holds a fused program to roofline
+gauges under its own label.
 ``--require-incidents`` requires the incident engine's evidence (ISSUE 13):
 at least one complete postmortem bundle under ``<dir>/incidents`` (manifest
 with a known class + cause, flight-recorder rings, decision trail, registry
@@ -350,6 +355,32 @@ def _check_costmodel(snap: dict) -> list:
     if not floors:
         problems.append("cost_component_min_s_total is empty (no invocation "
                         "ever folded its ledger into the floor)")
+    # Fused dispatch programs (ISSUE 14, runtime/stepbuilder.py): a
+    # *_fused program in compiles_total publishes under its OWN label, so
+    # beyond the every-program ledger check above it must show a measured
+    # wall and a nonzero measured host gap — a fused program whose whole
+    # point is host-gap amortization that never accumulated one means the
+    # dispatch boundary instrumentation is broken, not that gaps are zero
+    # (the between-dispatch eviction/admission work is never literally 0s).
+    host_gaps = {
+        g.get("labels", {}).get("program"): float(g.get("value", 0.0))
+        for g in snap.get("gauges", [])
+        if g.get("name") == "cost_host_gap_s_total"
+    }
+    for prog in compiled:
+        if not prog.endswith("_fused"):
+            continue
+        if walls.get(prog, 0.0) <= 0:
+            problems.append(
+                f"fused program {prog!r} has no measured cost_wall_s_total "
+                "(its invocations were never accumulated)"
+            )
+        if host_gaps.get(prog, 0.0) <= 0:
+            problems.append(
+                f"fused program {prog!r} has no nonzero "
+                "cost_host_gap_s_total (the fused-dispatch boundary never "
+                "measured a host gap)"
+            )
     return problems
 
 
@@ -576,6 +607,24 @@ def _check_profile(path: str, snap: dict) -> list:
     if not any(h.get("count") for h in gaps):
         problems.append("step_gap_s histogram empty (no consecutive decode "
                         "chunks recorded)")
+    # A fused step program (ISSUE 14) must publish roofline gauges under
+    # its OWN label — fused chunks dividing by actual fused steps is the
+    # per-iteration correctness the satellite pins, and a fused program
+    # silently folding into the unfused label would hide it.
+    fused = sorted({
+        c.get("labels", {}).get("program")
+        for c in snap.get("counters", [])
+        if c.get("name") == "compiles_total" and c.get("value")
+        and str(c.get("labels", {}).get("program", "")).endswith("_fused")
+    })
+    for prog in fused:
+        if not any(g.get("labels", {}).get("program") == prog
+                   and g["value"] > 0 for g in aoa):
+            problems.append(
+                f"fused program {prog!r} has no nonzero "
+                "achieved_over_achievable gauge (fused chunks must feed "
+                "the roofline under their own label)"
+            )
     trace_dir = path if os.path.isdir(path) else os.path.dirname(path)
     trace_path = os.path.join(trace_dir, TRACE_FILENAME)
     if not os.path.exists(trace_path):
