@@ -143,6 +143,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "combinable with --speculate (its verify window is "
                         "already multi-token; composition lands with tree "
                         "speculation)")
+    p.add_argument("--tp", type=int, default=None, metavar="N",
+                   help="with --continuous: tensor-parallel serving over an "
+                        "N-device tp mesh — every step program (prefill, "
+                        "decode, fused, paged) lowers as ONE SPMD "
+                        "computation: params sharded by the parallel/ "
+                        "rules, the slot KV cache / block arena sharded on "
+                        "kv heads, collectives inserted by XLA. N must "
+                        "divide the model's attention heads and the device "
+                        "count (checked at parse time). --tp 1 is "
+                        "byte-identical to no flag. Mutually exclusive "
+                        "with --mesh; CPU harness: "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N")
     p.add_argument("--paged-kv", action="store_true",
                    help="with --continuous: paged KV cache with radix-tree "
                         "prefix reuse (serving/paged.py) — slots hold block "
@@ -404,7 +416,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
         updates["speculation"] = SpeculationConfig(**spec_kwargs)
     if args.continuous or args.slots is not None or args.paged_kv \
             or args.kv_block_size is not None or args.kv_blocks is not None \
-            or args.fuse_steps is not None:
+            or args.fuse_steps is not None or args.tp is not None:
         from fairness_llm_tpu.config import ServingConfig
 
         if not args.paged_kv and (args.kv_block_size is not None
@@ -412,8 +424,47 @@ def config_from_args(args: argparse.Namespace) -> Config:
             raise SystemExit("--kv-block-size/--kv-blocks require --paged-kv")
         if not args.continuous:
             raise SystemExit(
-                "--slots/--paged-kv/--fuse-steps require --continuous")
+                "--slots/--paged-kv/--fuse-steps/--tp require --continuous")
         serve_kwargs = {"enabled": True}
+        if args.tp is not None:
+            # Same parse-time discipline as the --fuse-steps gates: every
+            # invalid combination dies HERE with the flag named, not
+            # minutes later inside a weight load or a jit trace.
+            if args.tp < 1:
+                raise SystemExit("--tp must be >= 1")
+            if args.mesh:
+                raise SystemExit(
+                    "--tp cannot combine with --mesh: --tp N builds the "
+                    "tp-only serving mesh itself (use --mesh for the "
+                    "static-engine dp/sp paths)")
+            if args.tp > 1:
+                if args.model and args.model not in (
+                        "simulated", "simulated-fair", "simulated-biased"):
+                    from fairness_llm_tpu.models.configs import (
+                        get_model_config,
+                    )
+
+                    try:
+                        mc = get_model_config(args.model)
+                    except KeyError:
+                        mc = None
+                    if mc is not None and (mc.num_heads % args.tp != 0 or
+                                           mc.num_kv_heads % args.tp != 0):
+                        raise SystemExit(
+                            f"--tp {args.tp} must divide {args.model}'s "
+                            f"attention heads ({mc.num_heads} q / "
+                            f"{mc.num_kv_heads} kv)")
+                import jax as _jax
+
+                if _jax.device_count() % args.tp != 0:
+                    raise SystemExit(
+                        f"--tp {args.tp} must divide the device count "
+                        f"({_jax.device_count()}); on CPU set XLA_FLAGS="
+                        f"--xla_force_host_platform_device_count={args.tp}")
+                serve_kwargs["tp"] = args.tp
+                from fairness_llm_tpu.config import MeshConfig
+
+                updates["mesh"] = MeshConfig(tp=args.tp)
         if args.slots is not None:
             if args.slots < 1:
                 raise SystemExit("--slots must be >= 1")

@@ -105,6 +105,15 @@ class ServingConfig:
     # Total arena blocks; None = 2x the all-slots-private worst case, so a
     # full pool still leaves an equal reserve working as prefix cache.
     kv_blocks: Optional[int] = None
+    # Tensor-parallel serving (--tp, runtime/stepbuilder.py's mesh axis):
+    # every compiled serving program lowers as ONE SPMD computation over a
+    # tp-way mesh — params placed by parallel/sharding.py rules, the KV
+    # cache/arena sharded on KV heads, XLA GSPMD inserting the all-reduces.
+    # The scheduler cross-checks this against the engine's actual mesh (a
+    # tp=2 ServingConfig on a meshless engine fails loudly at construction
+    # instead of silently serving single-device). tp=1 is byte-identical
+    # to the pre-mesh scheduler: same compile keys, same telemetry labels.
+    tp: int = 1
 
 
 @dataclasses.dataclass(frozen=True)

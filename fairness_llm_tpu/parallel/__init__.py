@@ -14,6 +14,9 @@ from fairness_llm_tpu.parallel.sharding import (
     param_shardings,
     shard_params,
     batch_sharding,
+    kv_heads_sharded,
+    kv_tree_shardings,
+    logits_sharding,
     per_device_param_bytes,
     per_device_kv_cache_bytes,
 )
@@ -24,6 +27,9 @@ __all__ = [
     "param_shardings",
     "shard_params",
     "batch_sharding",
+    "kv_heads_sharded",
+    "kv_tree_shardings",
+    "logits_sharding",
     "per_device_param_bytes",
     "per_device_kv_cache_bytes",
 ]

@@ -188,6 +188,53 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("dp"))
 
 
+def kv_heads_sharded(model_config: ModelConfig, mesh: Mesh) -> bool:
+    """True when the KV head axis shards under this mesh's rules — the same
+    divisibility fallback :func:`make_axis_rules` applies (GQA models with
+    kv_heads % tp != 0 replicate their KV, like production TP serving)."""
+    tp = mesh.shape.get("tp", 1)
+    return tp > 1 and model_config.num_kv_heads % tp == 0
+
+
+def kv_tree_shardings(model_config: ModelConfig, mesh: Mesh, tree: Any) -> Any:
+    """NamedSharding pytree for a serving KV container — the contiguous
+    ``KVCache`` or the paged ``BlockArena``.
+
+    Both lay their k/v leaves (and the int8 path's scales) out with the KV
+    head axis at position 2: ``[rows, slots, n_kv, head_dim]`` cache rows,
+    ``[blocks, block_size, n_kv, head_dim]`` arena blocks. Those leaves
+    shard on ``tp`` at the head axis when it divides (so each shard holds
+    its own heads' KV and the paged gather/scatter table ops — which index
+    axis 0 — stay local per shard); every bookkeeping leaf (key_valid,
+    positions, lengths, index) and a non-dividing head axis replicate.
+    Row/block axes never shard here: the slot scatter is not dp-aware,
+    which is exactly why the scheduler accepts tp-only meshes.
+    """
+    shard_heads = kv_heads_sharded(model_config, mesh)
+    n_kv = model_config.num_kv_heads
+
+    def spec_for(leaf) -> NamedSharding:
+        if (shard_heads and getattr(leaf, "ndim", 0) >= 3
+                and leaf.shape[2] == n_kv):
+            return NamedSharding(
+                mesh, P(*([None, None, "tp"] + [None] * (leaf.ndim - 3)))
+            )
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(spec_for, tree)
+
+
+def logits_sharding(model_config: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Sharding for the scheduler's carried ``[num_slots, vocab]`` sampler
+    logits: vocab over tp when it divides (matching the lm head's
+    ("batch", "seq", "vocab") activation constraint, so the decode
+    program's output lands where its input was), else replicated."""
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and model_config.vocab_size % tp == 0:
+        return NamedSharding(mesh, P(None, "tp"))
+    return NamedSharding(mesh, P())
+
+
 def per_device_param_bytes(model_config: ModelConfig, mesh: Mesh,
                            rules: Optional[AxisRules] = None,
                            itemsize: Optional[int] = None) -> int:

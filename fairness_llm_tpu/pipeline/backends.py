@@ -313,14 +313,16 @@ def backend_for(
     model_config = get_model_config(model_name)
     serving = getattr(config, "serving", None)
     use_serving = serving is not None and serving.enabled
-    if use_serving and config.mesh.num_devices > 1:
+    if use_serving and (config.mesh.dp > 1 or config.mesh.sp > 1):
         # Fail BEFORE the mesh is built and a (possibly sharded) checkpoint
-        # is loaded — the scheduler would reject the mesh at first generate()
-        # anyway, minutes of weight loading later.
+        # is loaded — the scheduler would reject the mesh at construction
+        # anyway, minutes of weight loading later. Tensor-parallel-only
+        # meshes (--tp N) DO compose with serving: the scheduler shards the
+        # slot cache on kv heads and runs every program SPMD over the mesh.
         raise ValueError(
-            "--continuous serving supports single-device engines only "
-            "(the KV slot scatter is not dp-aware yet); drop --mesh or "
-            "run without --continuous"
+            "--continuous serving supports single-device or tp-only meshes "
+            "(the KV slot scatter is not dp/sp-aware yet); use --tp N or "
+            "drop --mesh"
         )
     if getattr(config, "weight_quant", None) is not None:
         # Explicit override in EITHER direction: "int8" quantizes a float
@@ -371,9 +373,10 @@ def backend_for(
         resilience = None
     if use_serving:
         # Continuous-batching server (--continuous): same DecodeBackend
-        # surface, slot-recycled decode underneath. Single-device only
-        # (rejected above, before the weight load); speculation doesn't
-        # compose with the step-wise serving loop yet, so it is ignored.
+        # surface, slot-recycled decode underneath. Single-device or a
+        # tp-only mesh (dp/sp rejected above, before the weight load);
+        # speculation doesn't compose with the step-wise serving loop yet,
+        # so it is ignored.
         from fairness_llm_tpu.serving import ServingBackend
 
         journal = None

@@ -29,7 +29,19 @@ This module collapses them into compositions over four orthogonal axes:
   advancing in-program, so continuous batching, paged block tables, and
   the guard compose unchanged. Fused programs publish under their own
   telemetry label (:func:`program_label`) so the cost ledger, roofline
-  gauges, and host-gap accounting attribute them separately.
+  gauges, and host-gap accounting attribute them separately;
+- **mesh/sharding** — the axis PR 14 reserved: under a tp mesh every
+  composition lowers as ONE SPMD computation (params placed by
+  ``parallel/sharding.py`` rules, activations constrained along the model
+  axis by the transformer's ``with_logical_constraint`` annotations, the
+  contiguous KV cache and paged BlockArena sharded on KV heads so the
+  gather/scatter table ops stay local per shard). The programs themselves
+  are mesh-agnostic — the scheduler runs them inside ``with mesh,
+  nn.logical_axis_rules(...)`` and places the carried device state
+  (``parallel.sharding.kv_tree_shardings``); what changes here is the key
+  scheme (``tp`` appends a mesh element, :func:`compile_key`) and the
+  telemetry label (``@tp<k>`` suffix, :func:`program_label`), both
+  byte-identical at tp=1.
 
 Compile keys come from ONE scheme (:func:`compile_key`) instead of
 per-site tuple literals. Key invariants the rest of the stack relies on
@@ -71,13 +83,26 @@ STEP_PROGRAMS = ("serve_step", "paged_step",
                  "serve_step_fused", "paged_step_fused")
 
 
-def program_label(base: str, fuse: int = 1) -> str:
+def program_label(base: str, fuse: int = 1, tp: int = 1) -> str:
     """Telemetry name for a step program: fused dispatches (``fuse > 1``)
     publish under ``<base>_fused`` so their compile stats, cost ledger,
     roofline gauges, and host-gap accounting read apart from the per-chunk
     baseline (``validate_telemetry`` requires a fused program seen in
-    ``compiles_total`` to publish all three)."""
-    return base if fuse <= 1 else f"{base}_fused"
+    ``compiles_total`` to publish all three). Sharded programs (``tp > 1``)
+    additionally publish under ``<label>@tp<k>`` — a real-mesh program's
+    roofline/ledger/collectives accounting must never fold into the
+    single-device baseline it is being compared against. ``tp=1`` labels
+    are byte-identical to the pre-mesh scheme."""
+    label = base if fuse <= 1 else f"{base}_fused"
+    return label if tp <= 1 else f"{label}@tp{tp}"
+
+
+def base_program(label: str) -> str:
+    """Strip the mesh suffix off a :func:`program_label` name:
+    ``paged_step_fused@tp2`` -> ``paged_step_fused``. The inverse the
+    telemetry gates (``validate_telemetry``'s fused-program checks) use so
+    a sharded fused program is still recognized as fused."""
+    return label.split("@", 1)[0]
 
 
 def compile_key(program: str, *, batch: Optional[int] = None,
@@ -88,7 +113,8 @@ def compile_key(program: str, *, batch: Optional[int] = None,
                 ngram_max: Optional[int] = None,
                 draft_len: Optional[int] = None,
                 chunk: Optional[int] = None, fuse: int = 1,
-                nb: Optional[int] = None, P: Optional[int] = None) -> Tuple:
+                nb: Optional[int] = None, P: Optional[int] = None,
+                tp: int = 1) -> Tuple:
     """The one compile-key scheme for every step program.
 
     Axes are per-program-shape (batch/prompt buckets, decode caps), plus
@@ -96,22 +122,34 @@ def compile_key(program: str, *, batch: Optional[int] = None,
     arity), the mutable ``decode_chunk``, paged-ness (via the base name),
     and the fuse factor. See the module docstring for the pinned layout
     invariants.
+
+    The mesh axis: ``tp > 1`` APPENDS a ``("tp", k)`` element — a sharded
+    program lowers to a different SPMD computation (GSPMD-inserted
+    collectives, sharded cache layout) and must never alias the
+    single-device one. ``tp=1`` keys are byte-identical to the pre-mesh
+    scheme (pinned in tests), so existing baselines/goldens stay valid,
+    and the tagged-tuple element can never collide with a positional int
+    axis like ``fuse``.
     """
     if program == "prefix":
-        return ("prefix", prefix_len)
-    if program == "decode":
-        return ("decode", batch, prompt_len, max_new, sampler, prefix_len,
-                guard)
-    if program == "spec_decode":
+        key: Tuple = ("prefix", prefix_len)
+    elif program == "decode":
+        key = ("decode", batch, prompt_len, max_new, sampler, prefix_len,
+               guard)
+    elif program == "spec_decode":
         # ``guard`` sits mid-key: the speculation knobs stay the trailing
         # pair, which diagnostics (and the compile-key test) rely on.
-        return ("spec_decode", batch, prompt_len, max_new, prefix_len,
-                guard, ngram_max, draft_len)
-    if program in ("serve_prefill", "paged_prefill"):
-        return (program, nb, P, guard)
-    if program in ("serve_step", "paged_step"):
-        return (program, chunk, guard, fuse)
-    raise ValueError(f"unknown step program {program!r}")
+        key = ("spec_decode", batch, prompt_len, max_new, prefix_len,
+               guard, ngram_max, draft_len)
+    elif program in ("serve_prefill", "paged_prefill"):
+        key = (program, nb, P, guard)
+    elif program in ("serve_step", "paged_step"):
+        key = (program, chunk, guard, fuse)
+    else:
+        raise ValueError(f"unknown step program {program!r}")
+    if tp > 1:
+        key = key + (("tp", tp),)
+    return key
 
 
 # -- shared pieces -------------------------------------------------------------

@@ -57,8 +57,17 @@ containable ``NumericsFault`` (requeue-once, breaker-visible, counted in
 ``ScriptedFaultInjector(corruptions=...)`` poisons a request's carried
 logits host-side so the guard is drillable on the CPU harness.
 
-Sharded meshes are not supported yet (the slot scatter would need dp-aware
-placement); serving targets the single-chip engine. Multi-replica routing
+Tensor-parallel meshes ARE supported (``--tp N``): the scheduler accepts an
+engine built over a tp-only mesh, places the persistent KV cache / paged
+BlockArena on the mesh sharded along the kv-head axis
+(``parallel.sharding.kv_tree_shardings`` — gather/scatter table ops stay
+local to each shard) and the carried logits along vocab, and runs every
+compiled program under ``with mesh, nn.logical_axis_rules(...)`` so the
+whole step lowers as one SPMD computation with XLA-inserted collectives.
+Compile keys gain a ``("tp", k)`` element and telemetry programs a
+``@tp<k>`` label suffix — both byte-identical to the unsharded scheme at
+tp=1. dp/sp meshes are still rejected (the slot scatter would need dp-aware
+placement). Multi-replica routing
 IS the next layer up — ``serving/fleet.py`` drives N of these schedulers
 (one per replica, each with its own slot pool, breakers, and watchdog)
 through the public ``step()`` hook, with per-replica ``{"replica": name}``
@@ -72,6 +81,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -84,6 +94,7 @@ from fairness_llm_tpu.config import (
 )
 from fairness_llm_tpu.models.tokenizer import _left_pad
 from fairness_llm_tpu.models.transformer import init_cache
+from fairness_llm_tpu.parallel import sharding as shd
 from fairness_llm_tpu.resilience.breaker import BreakerBoard
 from fairness_llm_tpu.resilience.drain import (
     ServingJournal,
@@ -120,7 +131,11 @@ from fairness_llm_tpu.telemetry import (
     get_registry,
 )
 from fairness_llm_tpu.telemetry.compilestats import note_lookup, record_compile
-from fairness_llm_tpu.telemetry.costmodel import instrument_jit, note_invocation
+from fairness_llm_tpu.telemetry.costmodel import (
+    instrument_jit,
+    note_invocation,
+    tp_collective_costs,
+)
 from fairness_llm_tpu.telemetry.fairness import get_fairness_monitor
 from fairness_llm_tpu.telemetry.flightrecorder import get_flight_recorder
 from fairness_llm_tpu.telemetry.incidents import maybe_trigger, record_decision
@@ -168,14 +183,32 @@ class ContinuousScheduler:
         replica: Optional[str] = None,
         overload: Optional[OverloadConfig] = None,
     ):
-        if engine.mesh is not None:
+        mesh = engine.mesh
+        if mesh is not None and (mesh.shape.get("dp", 1) > 1
+                                 or mesh.shape.get("sp", 1) > 1):
             raise ValueError(
-                "ContinuousScheduler supports single-device engines only "
-                "(the slot scatter is not dp-aware yet); build the engine "
-                "without a mesh"
+                "ContinuousScheduler supports single-device and tp-only "
+                "meshes (the slot scatter is not dp/sp-aware yet); build "
+                "the engine with a tp-only mesh or without one"
             )
+        # Tensor-parallel serving (the stepbuilder's mesh axis): every
+        # compiled program runs inside ``with mesh, logical_axis_rules`` —
+        # params already placed by the engine, carried KV/logits placed by
+        # _place_device_state below — and keys/labels carry the mesh shape
+        # (byte-identical at tp=1).
+        self.mesh = mesh
+        self.tp = mesh.shape.get("tp", 1) if mesh is not None else 1
         self.engine = engine
         self.serving = serving or ServingConfig(enabled=True)
+        want_tp = max(1, getattr(self.serving, "tp", 1))
+        if want_tp > 1 and self.tp != want_tp:
+            raise ValueError(
+                f"ServingConfig.tp={want_tp} but the engine's mesh is "
+                f"{dict(mesh.shape) if mesh is not None else None}; build "
+                "the engine over a matching tp mesh (parallel.make_mesh) — "
+                "a silent single-device fallback would invalidate every "
+                "mesh-labeled measurement"
+            )
         self.settings = settings or ModelSettings()
         # Replica identity (serving/fleet.py): every instrument this
         # scheduler writes — tracer histograms, breaker/watchdog state,
@@ -286,6 +319,7 @@ class ContinuousScheduler:
         self._prev_logits = jnp.zeros(
             (self.num_slots, cfg.vocab_size), jnp.float32
         )
+        self._place_device_state()
         self._compiled: Dict[tuple, object] = {}
         # Overflow beyond queue capacity (deque: _feed pops from the head)
         self._pending: Deque[Request] = deque()
@@ -353,6 +387,42 @@ class ContinuousScheduler:
         self.live_cap = self.num_slots
         self._applied_level = 0
 
+    # -- mesh placement -----------------------------------------------------
+
+    def _place_device_state(self) -> None:
+        """Pin the persistent carried state to the mesh: KV (contiguous
+        cache or paged arena) sharded along the kv-head axis when tp
+        divides it (``kv_tree_shardings`` — per-row gather/scatter table
+        ops then stay local to each shard), carried logits along vocab.
+        Committed placement, so the jit'd programs consume the shards
+        in-place instead of re-replicating per call. No-op off-mesh."""
+        if self.mesh is None:
+            return
+        cfg = self.engine.config
+        if self._cache is not None:
+            self._cache = jax.tree.map(
+                jax.device_put, self._cache,
+                shd.kv_tree_shardings(cfg, self.mesh, self._cache),
+            )
+        if self._arena is not None:
+            self._arena = jax.tree.map(
+                jax.device_put, self._arena,
+                shd.kv_tree_shardings(cfg, self.mesh, self._arena),
+            )
+        self._prev_logits = jax.device_put(
+            self._prev_logits, shd.logits_sharding(cfg, self.mesh))
+
+    def _run_compiled(self, fn, *args):
+        """Invoke a compiled program under the mesh context: inside
+        ``with mesh, nn.logical_axis_rules(...)`` the program's logical
+        activation constraints resolve against the tp axis and the whole
+        step lowers as ONE SPMD computation (same pattern as
+        ``DecodeEngine._call``). Off-mesh this is a plain call."""
+        if self.mesh is None:
+            return fn(*args)
+        with self.mesh, nn.logical_axis_rules(self.engine.rules):
+            return fn(*args)
+
     # -- compiled programs --------------------------------------------------
 
     def _donate(self):
@@ -379,22 +449,33 @@ class ContinuousScheduler:
         variant shares (``stepbuilder.compile_key``)."""
         return compile_key("paged_step" if self.paged else "serve_step",
                            chunk=self.decode_chunk, guard=guard,
-                           fuse=self.fuse_steps)
+                           fuse=self.fuse_steps, tp=self.tp)
 
     def _step_program(self) -> str:
         """Telemetry label for the current decode program: fused dispatches
         publish their own compile stats / ledger / roofline gauges under
-        ``<base>_fused`` (``validate_telemetry`` holds them to that)."""
+        ``<base>_fused`` (``validate_telemetry`` holds them to that), and
+        mesh-sharded programs under a ``@tp<k>`` suffix so single-device
+        and sharded measurements never mix in one series."""
         return program_label("paged_step" if self.paged else "serve_step",
-                             self.fuse_steps)
+                             self.fuse_steps, tp=self.tp)
+
+    def _collectives(self, rows: int, tokens: int, scope: str):
+        """Analytic collectives rows for the cost ledger when the jaxpr
+        walk can't see them (GSPMD inserts all-reduces post-partitioning,
+        invisible to ``make_jaxpr``). [] at tp=1."""
+        return tp_collective_costs(self.engine.config, self.tp, rows,
+                                   tokens=tokens, scope=scope)
 
     def _prefill_fn(self, nb: int, P: int, guard: bool):
         """[nb, P] prompt prefill + row scatter into the shared cache — the
         builder's ``serve_prefill`` composition (see
         ``stepbuilder.build_serve_prefill`` for the program semantics)."""
-        key = compile_key("serve_prefill", nb=nb, P=P, guard=guard)
+        key = compile_key("serve_prefill", nb=nb, P=P, guard=guard,
+                          tp=self.tp)
+        program = program_label("serve_prefill", tp=self.tp)
         fn = self._compiled.get(key)
-        note_lookup("serve_prefill", hit=fn is not None, labels=self.labels)
+        note_lookup(program, hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
         run = build_serve_prefill(
@@ -405,7 +486,8 @@ class ContinuousScheduler:
         # OTHER live slots' cache rows intact, and a donated input buffer
         # doesn't survive a raised call. instrument_jit = jax.jit + the cost
         # ledger (telemetry/costmodel.py) on every compiled program.
-        fn = instrument_jit(run, "serve_prefill")
+        fn = instrument_jit(run, program,
+                            collectives=self._collectives(nb, P, "call"))
         self._compiled[key] = fn
         return fn
 
@@ -427,7 +509,10 @@ class ContinuousScheduler:
             num_slots=self.num_slots, chunk=self.decode_chunk, guard=guard,
             paged=self.paged, fuse=self.fuse_steps,
         )
-        fn = instrument_jit(run, program, donate_argnums=self._donate())
+        fn = instrument_jit(
+            run, program, donate_argnums=self._donate(),
+            collectives=self._collectives(self.num_slots, 1, "step"),
+        )
         self._compiled[key] = fn
         return fn
 
@@ -439,9 +524,11 @@ class ContinuousScheduler:
         ``stepbuilder.build_paged_prefill`` for the program semantics;
         parity with the non-paged path is pinned in tests/test_paged_kv.py.
         """
-        key = compile_key("paged_prefill", nb=nb, P=S, guard=guard)
+        key = compile_key("paged_prefill", nb=nb, P=S, guard=guard,
+                          tp=self.tp)
+        program = program_label("paged_prefill", tp=self.tp)
         fn = self._compiled.get(key)
-        note_lookup("paged_prefill", hit=fn is not None, labels=self.labels)
+        note_lookup(program, hit=fn is not None, labels=self.labels)
         if fn is not None:
             return fn
         run = build_paged_prefill(
@@ -450,7 +537,8 @@ class ContinuousScheduler:
         )
         # Not donated, like the plain prefill: a raised call must leave the
         # other live slots' arena blocks intact.
-        fn = instrument_jit(run, "paged_prefill")
+        fn = instrument_jit(run, program,
+                            collectives=self._collectives(nb, S, "call"))
         self._compiled[key] = fn
         return fn
 
@@ -1174,7 +1262,10 @@ class ContinuousScheduler:
         # First use of this (batch, prompt) bucket compiles; that wall is
         # exempt from hang classification (injected stalls still classify).
         guard = self._guard()
-        first_compile = ("serve_prefill", nb, P, guard) not in self._compiled
+        pf_key = compile_key("serve_prefill", nb=nb, P=P, guard=guard,
+                             tp=self.tp)
+        pf_program = program_label("serve_prefill", tp=self.tp)
+        first_compile = pf_key not in self._compiled
         fn = self._prefill_fn(nb, P, guard)
         pf_t0 = time.monotonic()
         for req in reqs:
@@ -1182,7 +1273,8 @@ class ContinuousScheduler:
         if self.watchdog is not None:
             self.watchdog.arm("prefill")
         try:
-            out = fn(
+            out = self._run_compiled(
+                fn,
                 self.engine.params, self._cache, self._prev_logits,
                 jnp.asarray(tokens), jnp.asarray(valid),
                 jnp.asarray(slot_ids),
@@ -1241,11 +1333,10 @@ class ContinuousScheduler:
         # serve_prefill by note_invocation below).
         get_timeline().note_busy(self._track, pf_t0, pf_wall)
         if first_compile:
-            record_compile("serve_prefill", reason="shape", seconds=pf_wall,
-                           track=self._track, key=("serve_prefill", nb, P,
-                                                   guard),
+            record_compile(pf_program, reason="shape", seconds=pf_wall,
+                           track=self._track, key=pf_key,
                            labels=self.labels, t0=pf_t0)
-        note_invocation("serve_prefill", pf_wall,
+        note_invocation(pf_program, pf_wall,
                         ledger=getattr(fn, "ledger", None),
                         compiling=first_compile)
         stats.prefill_batches += 1
@@ -1359,7 +1450,10 @@ class ContinuousScheduler:
         # of range, so nothing they compute lands anywhere.
         valid[len(rows):, 0] = True
         guard = self._guard()
-        first_compile = ("paged_prefill", nb, S, guard) not in self._compiled
+        pf_key = compile_key("paged_prefill", nb=nb, P=S, guard=guard,
+                             tp=self.tp)
+        pf_program = program_label("paged_prefill", tp=self.tp)
+        first_compile = pf_key not in self._compiled
         fn = self._paged_prefill_fn(nb, S, guard)
         pf_t0 = time.monotonic()
         for req, *_ in rows:
@@ -1367,7 +1461,8 @@ class ContinuousScheduler:
         if self.watchdog is not None:
             self.watchdog.arm("prefill")
         try:
-            out = fn(
+            out = self._run_compiled(
+                fn,
                 self.engine.params, self._arena, self._prev_logits,
                 jnp.asarray(tokens), jnp.asarray(valid),
                 jnp.asarray(positions), jnp.asarray(tables),
@@ -1425,11 +1520,10 @@ class ContinuousScheduler:
         )
         get_timeline().note_busy(self._track, pf_t0, pf_wall)
         if first_compile:
-            record_compile("paged_prefill", reason="shape", seconds=pf_wall,
-                           track=self._track,
-                           key=("paged_prefill", nb, S, guard),
+            record_compile(pf_program, reason="shape", seconds=pf_wall,
+                           track=self._track, key=pf_key,
                            labels=self.labels, t0=pf_t0)
-        note_invocation("paged_prefill", pf_wall,
+        note_invocation(pf_program, pf_wall,
                         ledger=getattr(fn, "ledger", None),
                         compiling=first_compile)
         stats.prefill_batches += 1
@@ -1517,14 +1611,16 @@ class ContinuousScheduler:
             self.watchdog.arm("decode")
         try:
             if self.paged:
-                out = fn(
+                out = self._run_compiled(
+                    fn,
                     self.engine.params, self._arena, self._prev_logits,
                     jnp.asarray(tables), jnp.asarray(wtables),
                     jnp.asarray(seeds), jnp.asarray(emitted),
                     jnp.asarray(base), jnp.asarray(caps), jnp.asarray(live),
                 )
             else:
-                out = fn(
+                out = self._run_compiled(
+                    fn,
                     self.engine.params, self._cache, self._prev_logits,
                     jnp.asarray(seeds), jnp.asarray(emitted),
                     jnp.asarray(base), jnp.asarray(caps), jnp.asarray(live),
@@ -1600,7 +1696,12 @@ class ContinuousScheduler:
                 self._cache = init_cache(
                     self.engine.config, self.num_slots, self.cache_len
                 )
-            self._prev_logits = jnp.zeros_like(self._prev_logits)
+            self._prev_logits = jnp.zeros(
+                (self.num_slots, self.engine.config.vocab_size), jnp.float32
+            )
+            # Fresh host-side buffers: re-pin them to the mesh, or the next
+            # compiled call would recompile against replicated layouts.
+            self._place_device_state()
             self.pool.take_invalidations()
             return True
         if self.breakers is not None:

@@ -17,7 +17,11 @@ backend, with no profiler capture:
   copy) and ``classify_eqn`` (jaxpr primitives, with a rank heuristic
   separating attention dots from parameter matmuls). Both emit the same
   labels: ``attention`` / ``kv_rw`` / ``weights_dma`` / ``matmuls`` /
-  ``norms_elementwise`` / ``sampling`` / ``gather_scatter`` / ``control``.
+  ``norms_elementwise`` / ``sampling`` / ``gather_scatter`` / ``control``
+  / ``collectives`` (TP communication — explicit psum/all_gather prims in
+  shard_map-manual jaxprs, the matching op names in xplane captures, and
+  the analytic ``tp_collective_costs`` rows for GSPMD-auto programs whose
+  collectives XLA inserts after partitioning, invisibly to the trace).
 - **Jaxpr cost walk** — ``jaxpr_ledger`` walks EVERY equation of a compiled
   program's jaxpr (recursing through pjit/cond/scan/custom calls),
   accumulating analytical bytes (input + output aval sizes — the
@@ -79,6 +83,14 @@ logger = logging.getLogger(__name__)
 # the live gauges use. The ordering is load-bearing (first match wins) and
 # pinned by tests/test_costmodel.py's historical-fixture regression.
 COMPONENTS: List[Tuple[str, "re.Pattern"]] = [
+    # Collectives FIRST: "all-gather"/"reduce-scatter" would otherwise fall
+    # into gather_scatter, and "all-reduce" must never reach any pattern
+    # with a bare "reduce". No bare "reduce" HERE either —
+    # "reduce_fusion"/"multiply_reduce" (attention score math) must keep
+    # classifying as attention, pinned by the historical-op fixtures.
+    ("collectives", re.compile(
+        r"all-reduce|all-gather|reduce-scatter|collective-permute"
+        r"|all-to-all|psum")),
     ("attention", re.compile(
         r"multiply_reduce|reduce_fusion|softmax|exponential|divide_fusion")),
     ("kv_rw", re.compile(r"dynamic-update-slice|update_slice")),
@@ -95,6 +107,7 @@ COMPONENTS: List[Tuple[str, "re.Pattern"]] = [
 # Human-readable expansions for report rendering (the labels themselves stay
 # short so they fit metric label values).
 COMPONENT_TITLES = {
+    "collectives": "collectives (TP comm)",
     "attention": "attention (scores/softmax)",
     "kv_rw": "KV read-write (DUS)",
     "weights_dma": "weight DMA / slices",
@@ -120,6 +133,18 @@ def classify(name: str) -> str:
 # -- jaxpr-level classification ------------------------------------------------
 
 _KV_PRIMS = frozenset({"dynamic_update_slice", "dynamic_slice"})
+# Cross-device communication primitives. These appear in a jaxpr only where
+# collectives are explicit at trace time — shard_map-manual code (QuantDense's
+# psum) or hand-written pmap-era programs. GSPMD-auto programs (the serving
+# step programs under a tp mesh) get their collectives inserted by XLA AFTER
+# partitioning, invisibly to make_jaxpr — those programs carry the analytic
+# row `tp_collective_costs` computes instead (see `instrument_jit`'s
+# ``collectives=`` hook).
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_reduce",
+    "reduce_scatter", "ppermute", "pshuffle", "all_to_all",
+    "psum_scatter", "pbroadcast",
+})
 _SAMPLING_PRIMS = frozenset({
     "sort", "argmax", "argmin", "top_k", "threefry2x32", "random_bits",
     "random_seed", "random_wrap", "random_fold_in", "random_unwrap",
@@ -204,6 +229,8 @@ def classify_eqn(eqn) -> str:
     (the cache is rank-4 too, but a DUS on it is KV traffic, not score
     math)."""
     name = eqn.primitive.name
+    if name in _COLLECTIVE_PRIMS:
+        return "collectives"
     if name in _KV_PRIMS:
         return "kv_rw"
     if name == "gather" or name.startswith("scatter"):
@@ -342,6 +369,49 @@ def jaxpr_ledger(closed_jaxpr, program: str) -> CostLedger:
     return ledger
 
 
+def tp_collective_costs(model_config, tp: int, rows: int, tokens: int = 1,
+                        scope: str = "step") -> List[Tuple[str, int, int]]:
+    """Analytic collective traffic of one GSPMD tensor-parallel forward —
+    the ``collectives`` ledger row for programs whose jaxpr cannot show it.
+
+    Under ``AxisType.Auto`` meshes XLA inserts the TP collectives AFTER
+    partitioning, so a serving program's ``make_jaxpr`` trace has none to
+    walk (only shard_map-manual code, e.g. QuantDense's psum, traces
+    them). This models the megatron pattern the sharding rules produce,
+    per forward of ``rows x tokens`` positions:
+
+    - one ring all-reduce of the ``[rows, tokens, d_model]`` activation
+      after each ROW-PARALLEL projection — the attention o-proj (when the
+      head axis shards) and the MLP down-proj (when the ff axis shards) —
+      at the ring cost of ``2 (tp-1)/tp`` bytes moved per device per
+      all-reduced byte;
+    - one all-gather of the ``[rows, tokens, vocab]`` logits when the lm
+      head shards, at ``(tp-1)/tp`` bytes.
+
+    Divisibility gates mirror ``parallel.sharding.make_axis_rules`` — an
+    axis that falls back to replicated produces no collective. FLOPs are
+    reported as 0 (comm is bandwidth, not compute). Returns ``[]`` when
+    nothing shards, so an effectively-replicated "mesh" run charges
+    nothing. Like the whole ledger, this is an analytic NOTHING-FUSES
+    model, not a measurement — the xplane table's ``collectives`` entry is
+    the measured view when a profiler capture exists.
+    """
+    if tp <= 1:
+        return []
+    itemsize = 2 if model_config.dtype == "bfloat16" else 4
+    act_bytes = rows * tokens * model_config.d_model * itemsize
+    all_reduces = (int(model_config.num_heads % tp == 0)
+                   + int(model_config.d_ff % tp == 0))
+    total = int(model_config.num_layers * all_reduces
+                * act_bytes * 2 * (tp - 1) / tp)
+    if model_config.vocab_size % tp == 0:
+        total += int(rows * tokens * model_config.vocab_size * itemsize
+                     * (tp - 1) / tp)
+    if total <= 0:
+        return []
+    return [(scope, total, 0)]
+
+
 # -- reference rates -----------------------------------------------------------
 # Companions of roofline.reference_achievable_gbps: a compute roofline and a
 # nominal per-dispatch host overhead, so min-times and the dispatch term are
@@ -465,22 +535,36 @@ class InstrumentedJit:
     program, on the same call that pays the XLA compile (tracing is a
     sliver of that wall), BEFORE the jitted call — donated input buffers
     are gone after it. A failed trace logs once and never fails the decode;
-    the jitted function is untouched either way."""
+    the jitted function is untouched either way.
 
-    def __init__(self, pyfn, program: str, **jit_kwargs):
+    ``collectives``: optional ``[(scope, bytes, flops), ...]`` rows folded
+    into the ledger's ``collectives`` component after the walk — the
+    analytic traffic of GSPMD-inserted collectives a tp>1 program executes
+    but ``make_jaxpr`` cannot see (``tp_collective_costs`` computes them
+    from the sharding rules). Skipped when the walk already found explicit
+    collectives (shard_map-manual programs), so nothing double-counts."""
+
+    def __init__(self, pyfn, program: str, collectives=None, **jit_kwargs):
         self._pyfn = pyfn
         self._jit = jax.jit(pyfn, **jit_kwargs)
         self.program = program
         self.ledger: Optional[CostLedger] = None
         self._ledger_failed = False
+        self._collectives = list(collectives or ())
 
     def __call__(self, *args):
         if self.ledger is None and not self._ledger_failed \
                 and attribution_on():
             try:
-                self.ledger = jaxpr_ledger(
+                ledger = jaxpr_ledger(
                     jax.make_jaxpr(self._pyfn)(*args), self.program
                 )
+                if self._collectives and not any(
+                        "collectives" in ledger._table(s)
+                        for s in ("call", "step")):
+                    for scope, b, f in self._collectives:
+                        ledger.record(scope, "collectives", int(b), int(f))
+                self.ledger = ledger
                 publish_ledger(self.ledger)
             except Exception as e:  # noqa: BLE001 — diagnostics only
                 self._ledger_failed = True
@@ -489,11 +573,14 @@ class InstrumentedJit:
         return self._jit(*args)
 
 
-def instrument_jit(pyfn, program: str, **jit_kwargs) -> InstrumentedJit:
+def instrument_jit(pyfn, program: str, collectives=None,
+                   **jit_kwargs) -> InstrumentedJit:
     """``jax.jit`` + cost-ledger instrumentation — the drop-in the decode
     program builders use. ``jit_kwargs`` pass through (``donate_argnums``
-    for the step programs)."""
-    return InstrumentedJit(pyfn, program, **jit_kwargs)
+    for the step programs); ``collectives`` injects the analytic tp
+    communication rows (see :class:`InstrumentedJit`)."""
+    return InstrumentedJit(pyfn, program, collectives=collectives,
+                           **jit_kwargs)
 
 
 # -- gap decomposition / report ------------------------------------------------

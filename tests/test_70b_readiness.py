@@ -122,6 +122,7 @@ def compiled_70b():
     return cfg, mesh, rules, compiled
 
 
+@pytest.mark.slow  # ~80 s: the 80-layer AOT backend-compile dominates tier-1
 def test_70b_aot_compiles_tp8(compiled_70b):
     # Existence of `compiled` IS the proof — GSPMD accepted every rule and
     # laid out all 80 layers' collectives at tp=8.
@@ -129,6 +130,7 @@ def test_70b_aot_compiles_tp8(compiled_70b):
     assert compiled.memory_analysis() is not None
 
 
+@pytest.mark.slow  # shares compiled_70b — must move with the test above
 def test_70b_param_bytes_match_compiled_analysis(compiled_70b):
     cfg, mesh, rules, compiled = compiled_70b
     analytic = shd.per_device_param_bytes(cfg, mesh, rules)

@@ -135,6 +135,7 @@ def compiled_7b(request):
     return cfg, mesh, rules, compiled
 
 
+@pytest.mark.slow  # ~30 s per family: AOT backend-compiles dominate tier-1
 def test_7b_aot_compiles_tp8(compiled_7b):
     # Existence of `compiled` IS the proof — GSPMD accepted every rule
     # (including gemma's tied vocab-sharded embedding-as-lm_head and
@@ -143,6 +144,7 @@ def test_7b_aot_compiles_tp8(compiled_7b):
     assert compiled.memory_analysis() is not None
 
 
+@pytest.mark.slow  # shares compiled_7b — must move with the test above
 def test_7b_param_bytes_match_compiled_analysis(compiled_7b):
     cfg, mesh, rules, compiled = compiled_7b
     analytic = shd.per_device_param_bytes(cfg, mesh, rules)

@@ -1,6 +1,7 @@
-"""Decode cost ledger + perf sentinel tests (ISSUE 12).
+"""Decode cost ledger + perf sentinel tests (ISSUE 12; collectives
+component added by ISSUE 16).
 
-Four contracts:
+Five contracts:
 
 - the SHARED component taxonomy: ``tools/account_decode_step.py`` imports
   the first-match-wins ``COMPONENTS`` table from ``telemetry/costmodel.py``
@@ -15,7 +16,11 @@ Four contracts:
   components sum to the measured wall exactly;
 - the perf sentinel accepts a clean same-fingerprint re-run, rejects an
   injected 3x slowdown and token-parity drift, and REFUSES a baseline
-  recorded under a different harness fingerprint.
+  recorded under a different harness fingerprint;
+- the ``collectives`` component is pinned at all three layers — xplane
+  regex (first-match, ahead of gather/attention), jaxpr primitives
+  (shard_map psum oracle), and the analytic ``tp_collective_costs``
+  injection for GSPMD-auto tp programs, with its double-count guard.
 """
 
 import copy
@@ -227,6 +232,127 @@ def test_decode_step_bytes_paged_oracle():
                                      "chunk_steps": 1})
     assert paged1 == base + 4 * kv
     assert paged1 > paged8 > base
+
+
+# -- collectives component (ISSUE 16 satellite) --------------------------------
+#
+# Three layers, each pinned: the xplane regex (measured captures), the jaxpr
+# primitive set (shard_map-manual programs), and the analytic injection path
+# (GSPMD-auto tp programs whose jaxpr cannot show the collectives XLA adds
+# after partitioning).
+
+
+# Collective op names as they appear in real xplane captures; all must land
+# in "collectives". Ordering is load-bearing: "all-gather"/"reduce-scatter"
+# must NOT fall through to gather_scatter or attention's reduce pattern
+# (the HISTORICAL_OP_FIXTURES above re-running unchanged pins the converse —
+# "reduce_fusion" stays attention, "gather.11" stays gather_scatter).
+COLLECTIVE_OP_FIXTURES = [
+    "all-reduce.1",
+    "all-reduce-start",
+    "all-gather.3",
+    "reduce-scatter_fusion",
+    "collective-permute.2",
+    "all-to-all",
+    "psum",
+]
+
+
+@pytest.mark.parametrize("name", COLLECTIVE_OP_FIXTURES)
+def test_collective_op_names_classify_as_collectives(name):
+    assert classify(name) == "collectives"
+
+
+def test_collectives_is_first_match_in_components():
+    # First-match-wins: collectives must outrank gather_scatter/attention so
+    # "all-gather"/"reduce-scatter" never misfile as memory ops.
+    assert COMPONENTS[0][0] == "collectives"
+
+
+def test_jaxpr_ledger_shard_map_psum_is_collectives():
+    # The jaxpr-visible path: shard_map-manual code traces its psum
+    # explicitly (unlike GSPMD-auto programs), and the walk descends into
+    # the shard_map sub-jaxpr and books it under "collectives".
+    import numpy as np
+
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    fn = compat_shard_map(lambda x: lax.psum(x, "tp"), mesh,
+                          in_specs=P("tp"), out_specs=P())
+    led = _ledger_of(fn, jnp.ones((8,), jnp.float32))
+    assert "collectives" in led.per_call
+    c = led.per_call["collectives"]
+    # psum over the per-device [4] f32 shard: in + out avals.
+    assert c.bytes == 2 * 4 * 4
+    assert c.flops == 4
+
+
+def test_tp_collective_costs_oracle():
+    from fairness_llm_tpu.telemetry.costmodel import tp_collective_costs
+
+    cfg = get_model_config("tiny-test")  # f32, 2 layers, 4 heads, d_ff 128,
+    #                                      d_model 64, vocab 512
+    # tp=2, 2 rows x 1 token: both the head and ff axes shard -> one ring
+    # all-reduce of the [2, 1, 64] f32 activation per projection per layer
+    # at 2(tp-1)/tp, plus the (tp-1)/tp logits all-gather.
+    act = 2 * 1 * 64 * 4
+    expect = int(2 * 2 * act * 2 * (1 / 2)) + int(2 * 1 * 512 * 4 * (1 / 2))
+    assert tp_collective_costs(cfg, 2, rows=2, tokens=1) == \
+        [("step", expect, 0)]
+    assert expect == 4096  # the exact serve_step@tp2 row serve_tp asserts
+    # tp=8: heads (4) fall back to replicated -> only the ff all-reduce and
+    # the vocab all-gather charge.
+    expect8 = (int(2 * 1 * act * 2 * (7 / 8))
+               + int(2 * 1 * 512 * 4 * (7 / 8)))
+    assert tp_collective_costs(cfg, 8, rows=2, tokens=1) == \
+        [("step", expect8, 0)]
+    # Identity / nothing-shards cases charge nothing.
+    assert tp_collective_costs(cfg, 1, rows=2) == []
+    assert tp_collective_costs(cfg, 3, rows=2) == []  # no axis divides by 3
+    # scope passes through (prefill books per-call, not per-step).
+    assert tp_collective_costs(cfg, 2, rows=2, tokens=1,
+                               scope="call")[0][0] == "call"
+
+
+def test_instrument_jit_injects_analytic_collectives():
+    from fairness_llm_tpu.telemetry.costmodel import instrument_jit
+
+    with use_registry() as reg, use_timeline():
+        run = instrument_jit(lambda x: x * 2.0, "toy_tp@tp2",
+                             collectives=[("step", 4096, 0)])
+        run(jnp.ones((8,), jnp.float32))
+        snap = snapshot(reg)
+    assert run.ledger is not None
+    assert run.ledger.per_step["collectives"].bytes == 4096
+    rows = [g for g in snap["gauges"]
+            if g["name"] == "cost_ledger_bytes"
+            and g["labels"].get("component") == "collectives"]
+    assert rows and all(g["labels"]["program"] == "toy_tp@tp2"
+                        for g in rows)
+    assert sum(g["value"] for g in rows) == 4096
+
+
+def test_instrument_jit_never_double_counts_explicit_collectives():
+    # A shard_map-manual program already traces its psum; the analytic rows
+    # must be DROPPED for it, or collectives would be charged twice.
+    import numpy as np
+
+    from fairness_llm_tpu.parallel.sharding import compat_shard_map
+    from fairness_llm_tpu.telemetry.costmodel import instrument_jit
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    fn = compat_shard_map(lambda x: lax.psum(x, "tp"), mesh,
+                          in_specs=P("tp"), out_specs=P())
+    with use_registry(), use_timeline():
+        run = instrument_jit(fn, "toy_manual",
+                             collectives=[("call", 999_999, 0)])
+        run(jnp.ones((8,), jnp.float32))
+    assert run.ledger is not None
+    # Only the walked psum traffic — the analytic 999_999 row was skipped.
+    assert run.ledger.per_call["collectives"].bytes == 2 * 4 * 4
 
 
 # -- six decode variants publish ledgers + decomposition sums ------------------

@@ -368,7 +368,11 @@ def _check_costmodel(snap: dict) -> list:
         if g.get("name") == "cost_host_gap_s_total"
     }
     for prog in compiled:
-        if not prog.endswith("_fused"):
+        # Mesh-sharded programs carry a @tp<k> label suffix
+        # (runtime/stepbuilder.program_label); strip it before the
+        # *_fused structural check so fused sharded programs are held to
+        # the same contract as their single-device twins.
+        if not prog.split("@", 1)[0].endswith("_fused"):
             continue
         if walls.get(prog, 0.0) <= 0:
             problems.append(
@@ -380,6 +384,26 @@ def _check_costmodel(snap: dict) -> list:
                 f"fused program {prog!r} has no nonzero "
                 "cost_host_gap_s_total (the fused-dispatch boundary never "
                 "measured a host gap)"
+            )
+    # Tensor-parallel programs (the stepbuilder's mesh axis): a @tp<k>
+    # program runs real collectives, so its ledger must carry a nonzero
+    # `collectives` component — a sharded run whose ledger shows none
+    # means the collectives attribution (jaxpr prims, xplane regexes, or
+    # the analytic GSPMD rows) silently fell through.
+    coll = {}
+    for g in snap.get("gauges", []):
+        if (g.get("name") == "cost_ledger_bytes"
+                and g.get("labels", {}).get("component") == "collectives"):
+            prog = g.get("labels", {}).get("program")
+            coll[prog] = coll.get(prog, 0.0) + float(g.get("value", 0.0))
+    for prog in compiled:
+        if "@tp" not in prog:
+            continue
+        if coll.get(prog, 0.0) <= 0:
+            problems.append(
+                f"sharded program {prog!r} has no nonzero collectives "
+                "component in cost_ledger_bytes (tensor-parallel comm "
+                "never attributed)"
             )
     return problems
 
@@ -615,7 +639,8 @@ def _check_profile(path: str, snap: dict) -> list:
         c.get("labels", {}).get("program")
         for c in snap.get("counters", [])
         if c.get("name") == "compiles_total" and c.get("value")
-        and str(c.get("labels", {}).get("program", "")).endswith("_fused")
+        and str(c.get("labels", {}).get("program", ""))
+        .split("@", 1)[0].endswith("_fused")  # @tp<k> mesh suffix strips off
     })
     for prog in fused:
         if not any(g.get("labels", {}).get("program") == prog
