@@ -665,6 +665,12 @@ def telemetry_report(argv) -> int:
         # jaxpr cost walk (telemetry/costmodel.py) — the standalone
         # `perf-report` subcommand renders the same decomposition alone.
         print("\n" + render_cost_report(snap))
+    from fairness_llm_tpu.telemetry import has_memory_data, render_memory_report
+
+    if has_memory_data(snap):
+        # Memory-ledger section rides along whenever the run accounted
+        # device memory (telemetry/memory.py); `memory-report` standalone.
+        print("\n" + render_memory_report(snap))
     if any(row.get("labels", {}).get("component") == "fairness"
            for section in ("counters", "gauges")
            for row in snap.get(section, [])):
@@ -718,6 +724,39 @@ def perf_report(argv) -> int:
     snap = load_snapshot(a.path)
     print(render_cost_report(snap))
     if a.require_ledger and not has_cost_data(snap):
+        return 1
+    return 0
+
+
+def memory_report(argv) -> int:
+    """``cli memory-report <dir|snapshot.json>`` — render the HBM memory
+    ledger a run recorded (telemetry/memory.py): per-pool residency
+    (params / contiguous KV / paged arena / prefix cache / carried
+    logits), the reconciliation verdict against the device's own
+    ``memory_stats`` (measured on TPU, indicative on CPU), headroom
+    against the limit, and the per-program AOT memory table XLA budgeted
+    (``compiled.memory_analysis``). See docs/OBSERVABILITY.md §Memory
+    signals."""
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu memory-report",
+        description="Render the HBM memory ledger from a telemetry "
+                    "snapshot",
+    )
+    ap.add_argument("path", help="telemetry dir (uses telemetry_snapshot."
+                                 "json inside) or a snapshot file")
+    ap.add_argument("--require-ledger", action="store_true",
+                    help="exit non-zero when the snapshot has no memory-"
+                         "ledger data (a CI gate)")
+    a = ap.parse_args(argv)
+    from fairness_llm_tpu.telemetry import (
+        has_memory_data,
+        load_snapshot,
+        render_memory_report,
+    )
+
+    snap = load_snapshot(a.path)
+    print(render_memory_report(snap))
+    if a.require_ledger and not has_memory_data(snap):
         return 1
     return 0
 
@@ -974,6 +1013,8 @@ def main(argv=None) -> int:
         return telemetry_report(argv[1:])
     if argv and argv[0] == "perf-report":
         return perf_report(argv[1:])
+    if argv and argv[0] == "memory-report":
+        return memory_report(argv[1:])
     if argv and argv[0] == "slo-report":
         return slo_report(argv[1:])
     if argv and argv[0] == "fairness-report":

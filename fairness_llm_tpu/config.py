@@ -181,6 +181,12 @@ class OverloadConfig:
     # brownout beat no answers; interactive budgets are never touched).
     batch_token_cap: int = 32
     retry_after_s: float = 1.0  # base retry-after hint for class sheds
+    # Opt-in (> 0): engage the rung-2 batch-token clamp EARLY whenever the
+    # memory ledger's measured HBM headroom fraction falls to/below this —
+    # decode tokens are KV bytes, so shortening batch answers is the
+    # cheapest lever against an approaching memory wall (ISSUE 18). 0
+    # keeps the ladder purely load-driven (byte-identical to before).
+    headroom_cap_frac: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,6 +269,10 @@ class AutoscaleConfig:
     up_burn_threshold: float = 2.0
     up_queue_frac: float = 0.8
     up_overload_level: int = 1
+    # Opt-in (> 0): treat measured HBM headroom at/under this fraction of
+    # the device limit as a hot signal (memory ledger, ISSUE 18) — more
+    # replicas spread the KV pools across more devices' HBM. 0 disables.
+    up_headroom_frac: float = 0.0
     up_window_s: float = 1.0  # sustained hot before a scale-up
     # Scale-down: burn under down_burn_threshold AND queue under
     # down_queue_frac AND per-replica slot load under down_load_frac,

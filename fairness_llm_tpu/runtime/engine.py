@@ -140,6 +140,11 @@ def _bucket_batch(n: int, mesh: Optional[jax.sharding.Mesh] = None) -> int:
 class DecodeEngine:
     """Owns params + compiled decode programs for one model."""
 
+    # Stable memory-ledger handles across engine instances in one process
+    # (fleets build several engines; re-registering "engine0" from a second
+    # instance would silently replace the first's params entry).
+    _mem_seq = 0
+
     def __init__(
         self,
         model_config: ModelConfig,
@@ -220,14 +225,6 @@ class DecodeEngine:
                 "%s on mesh %s: ~%.2f GB params per device",
                 model_config.name, dict(self.mesh.shape), pb / 1e9,
             )
-            stats = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
-            limit = stats.get("bytes_limit")
-            if limit and pb > 0.95 * limit:
-                logger.warning(
-                    "per-device params (%.1f GB) likely exceed the chip's %.1f GB "
-                    "HBM — use a larger tp axis or quantized weights",
-                    pb / 1e9, limit / 1e9,
-                )
         if params is None:
             logger.info("initializing random params for %s", model_config.name)
             # Low-memory init: allocates each leaf directly in the target
@@ -254,6 +251,43 @@ class DecodeEngine:
             params = shd.shard_params(params, shardings)
         self.params = params
         self._compiled: Dict[Tuple, Any] = {}
+        self._mem_handle = f"engine{DecodeEngine._mem_seq}"
+        DecodeEngine._mem_seq += 1
+        self._account_params_memory()
+
+    def _account_params_memory(self) -> None:
+        """Params-vs-HBM preflight, ledger edition (ISSUE 18): register
+        the live param tree under ``pool="params"`` — which publishes the
+        ``hbm_bytes`` gauge and, through reconciliation, the limit/
+        headroom gauges — and re-check the per-device fit against the
+        limit the device itself reports. The old one-shot log line only
+        ran at first init; this fires again on every engine rebuild (the
+        VMEM-fallback path), so a rebuilt engine's accounting stays
+        live."""
+        from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+            device_memory_stats,
+            get_memory_ledger,
+        )
+
+        get_memory_ledger().register("params", self._mem_handle,
+                                     self.params)
+        limit = device_memory_stats().get("bytes_limit")
+        if limit and self.mesh is not None:
+            pb = shd.per_device_param_bytes(
+                self.config, self.mesh, self.rules,
+                itemsize=self.param_itemsize,
+            )
+            if pb > 0.95 * limit:
+                logger.warning(
+                    "per-device params (%.1f GB) likely exceed the chip's "
+                    "%.1f GB HBM — use a larger tp axis or quantized "
+                    "weights", pb / 1e9, limit / 1e9,
+                )
+
+    def _prefix_kv_handle(self, kv_key) -> str:
+        """Ledger handle for one prefix-KV LRU entry (stable within this
+        process, which is all register/release needs)."""
+        return f"{self._mem_handle}:prefix:{abs(hash(kv_key)):x}"
 
     @property
     def seq_bucket(self) -> int:
@@ -582,10 +616,30 @@ class DecodeEngine:
                 # Each cached prefix KV holds device memory (layers x [Pc, H, D]);
                 # evict the oldest beyond a small working set so a long-lived
                 # engine serving many different sweeps doesn't accumulate HBM.
+                # ISSUE 18: each entry is registered with the memory ledger
+                # under pool="prefix_cache" (bytes held ride hbm_bytes) and
+                # released on evict; entry count and evictions get their
+                # own instruments — this LRU was device memory with zero
+                # telemetry before.
+                from fairness_llm_tpu.telemetry.memory import (  # lazy
+                    get_memory_ledger,
+                )
+
+                mem = get_memory_ledger()
                 kv_keys = [k for k in self._compiled if k[0] == "prefix_kv"]
                 while len(kv_keys) >= 4:
-                    del self._compiled[kv_keys.pop(0)]
+                    victim = kv_keys.pop(0)
+                    del self._compiled[victim]
+                    mem.release("prefix_cache", self._prefix_kv_handle(victim))
+                    get_registry().counter(
+                        "prefix_kv_evictions_total", component="engine"
+                    ).inc()
                 self._compiled[kv_key] = shared_layers
+                mem.register("prefix_cache", self._prefix_kv_handle(kv_key),
+                             shared_layers)
+                get_registry().gauge(
+                    "prefix_kv_entries", component="engine"
+                ).set(len(kv_keys) + 1)
 
         seeds_j = jnp.asarray(row_seeds_arr)
         live = np.zeros(batch, dtype=bool)
@@ -643,6 +697,9 @@ class DecodeEngine:
                     k: v for k, v in self._compiled.items()
                     if k[0] == "prefix_kv"
                 }
+                # Rebuild = a fresh accounting pass: the preflight fires
+                # here too now, not just at first init (ISSUE 18).
+                self._account_params_memory()
                 fn = build_fn()
                 res = call(fn)
             elif use_spec and self.breakers is not None:

@@ -137,6 +137,18 @@ class Autoscaler:
             fracs.append(outstanding / max(sched.num_slots, 1))
         return sum(fracs) / len(fracs)
 
+    def _headroom_frac(self) -> float:
+        """HBM headroom as a fraction of the device limit, from the memory
+        ledger (ISSUE 18). 1.0 when no limit is known (CPU without an
+        injected budget) — unknown must read as 'no opinion', never as
+        pressure."""
+        from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+            get_memory_ledger,
+        )
+
+        frac = get_memory_ledger().headroom_frac()
+        return 1.0 if frac is None else frac
+
     def signals(self) -> Dict[str, float]:
         """The controller's current inputs, for events and reports."""
         return {
@@ -144,6 +156,7 @@ class Autoscaler:
             "queue_frac": round(self._queue_frac(), 3),
             "overload_level": self._overload_level(),
             "load_frac": round(self._load_frac(), 3),
+            "headroom_frac": round(self._headroom_frac(), 3),
         }
 
     def _hot_reason(self, sig: Dict[str, float]) -> Optional[str]:
@@ -155,6 +168,13 @@ class Autoscaler:
         if cfg.up_overload_level > 0 and \
                 sig["overload_level"] >= cfg.up_overload_level:
             return f"overload_level {sig['overload_level']}"
+        # Opt-in (up_headroom_frac > 0): a measured-HBM headroom collapse
+        # is a capacity signal like a deep queue — scaling up spreads the
+        # KV pools across more replicas' devices. Soft by design: the
+        # ledger forewarns, the arena allocator stays the hard gate.
+        if cfg.up_headroom_frac > 0 and \
+                sig["headroom_frac"] <= cfg.up_headroom_frac:
+            return f"hbm_headroom {sig['headroom_frac']:.2f}"
         return None
 
     def _cold(self, sig: Dict[str, float]) -> bool:

@@ -961,6 +961,11 @@ class ReplicaSet:
             len(rep.sched.queue),
         )
         migrated = self._evacuate(rep, "retired", count_failover=False)
+        # Retirement is the permanent exit: the scheduler's device trees
+        # (arena/cache/logits) leave with it, so its memory-ledger entries
+        # go too — a fence keeps the replica AND its memory, so the fence
+        # path deliberately does not release (ISSUE 18).
+        rep.sched.release_memory()
         # Fold the retired replica's stats into the next stats close so
         # its completed/shed/token counts survive the membership change.
         rep.sched.finish_stats(rep.stats)

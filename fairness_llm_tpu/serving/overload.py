@@ -218,9 +218,25 @@ class ShedController:
     def batch_cap(self, cap: int, qos: str) -> int:
         """Rung >= 2: clamp a batch request's decode budget to
         ``batch_token_cap`` (brownout: shorter answers beat no answers).
-        Interactive and probe budgets are never touched."""
-        if self.level >= 2 and qos == "batch":
+        Interactive and probe budgets are never touched.
+
+        With ``headroom_cap_frac`` opted in (> 0), the same clamp also
+        engages BEFORE rung 2 whenever the memory ledger's measured HBM
+        headroom falls below that fraction — every decode token is KV
+        bytes, so shortening batch answers is the cheapest lever against
+        an approaching memory wall (ISSUE 18)."""
+        if qos != "batch":
+            return cap
+        if self.level >= 2:
             return max(1, min(cap, self.config.batch_token_cap))
+        if self.config.headroom_cap_frac > 0:
+            from fairness_llm_tpu.telemetry.memory import (  # lazy
+                get_memory_ledger,
+            )
+
+            frac = get_memory_ledger().headroom_frac()
+            if frac is not None and frac <= self.config.headroom_cap_frac:
+                return max(1, min(cap, self.config.batch_token_cap))
         return cap
 
     def retry_after(self, est_ttft: Optional[float] = None) -> float:

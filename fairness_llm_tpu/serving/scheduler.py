@@ -171,6 +171,10 @@ class ContinuousScheduler:
         results = sched.serve([Request(prompt=p) for p in prompts])
     """
 
+    # Memory-ledger handle sequence across scheduler instances in one
+    # process (a fleet holds several; handles must not collide).
+    _mem_seq = 0
+
     def __init__(
         self,
         engine,
@@ -320,6 +324,10 @@ class ContinuousScheduler:
             (self.num_slots, cfg.vocab_size), jnp.float32
         )
         self._place_device_state()
+        self._mem_handle = f"sched{ContinuousScheduler._mem_seq}"
+        ContinuousScheduler._mem_seq += 1
+        self._block_pressure = False
+        self._account_device_state()
         self._compiled: Dict[tuple, object] = {}
         # Overflow beyond queue capacity (deque: _feed pops from the head)
         self._pending: Deque[Request] = deque()
@@ -411,6 +419,85 @@ class ContinuousScheduler:
             )
         self._prev_logits = jax.device_put(
             self._prev_logits, shd.logits_sharding(cfg, self.mesh))
+
+    # -- memory ledger (ISSUE 18) -------------------------------------------
+
+    def _account_device_state(self) -> None:
+        """Register this scheduler's persistent device trees with the
+        memory ledger — the paged arena under ``kv_paged``, the contiguous
+        slot cache under ``kv_contiguous``, the carried logits under
+        ``logits_carry`` — so ``hbm_bytes{pool}`` tracks the live trees.
+        Runs at init AND after the containment rebuild (re-registering the
+        same handle replaces the entry: the rebuild made new arrays of the
+        same shape, and the gauges must say so rather than go stale)."""
+        from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+            get_memory_ledger,
+            tree_device_bytes,
+        )
+
+        mem = get_memory_ledger()
+        if self._arena is not None:
+            mem.register("kv_paged", f"{self._mem_handle}:arena",
+                         self._arena, replica=self.replica)
+            # Per-block device bytes, from the REAL arena (quantization,
+            # validity/position planes included) — what the headroom
+            # forecaster prices an admission's block growth with.
+            self._block_bytes = (tree_device_bytes(self._arena)
+                                 // max(1, self.pool.paged.num_blocks))
+        else:
+            self._block_bytes = 0
+        if self._cache is not None:
+            mem.register("kv_contiguous", f"{self._mem_handle}:cache",
+                         self._cache, replica=self.replica)
+        mem.register("logits_carry", f"{self._mem_handle}:logits",
+                     self._prev_logits, replica=self.replica)
+
+    def release_memory(self) -> None:
+        """Drop every ledger entry this scheduler registered — the fleet
+        calls it at replica retirement (the permanent exit; fences keep
+        the replica and its memory)."""
+        from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+            get_memory_ledger,
+        )
+
+        get_memory_ledger().release_matching(f"{self._mem_handle}:")
+
+    def _note_block_pressure(self, exhausted: bool, deferred) -> None:
+        """Memory-pressure bookkeeping for the block-exhaustion deferral:
+        flip the recoverable ``memory_pressure_active`` gauge, and on
+        exhaustion price the deferred admission's worst-case private-block
+        growth against the measured headroom and fire the deduplicated
+        ``memory_pressure`` incident naming the deferring requests. Soft
+        path only — the arena allocator stays the hard gate; this is the
+        measured basis the deferral always lacked."""
+        from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+            get_memory_ledger,
+        )
+
+        mem = get_memory_ledger()
+        scope = self.replica or "serving"
+        if not exhausted:
+            if self._block_pressure:
+                self._block_pressure = False
+                mem.note_pressure(scope, False)
+            return
+        self._block_pressure = True
+        mem.note_pressure(scope, True)
+        # Worst case: the head-of-line row shares nothing and claims a
+        # full slot's private blocks.
+        fc = mem.forecast(self.pool.paged.blocks_per_slot
+                          * self._block_bytes)
+        maybe_trigger(
+            "memory_pressure",
+            cause=f"paged arena exhausted; {len(deferred)} admission(s) "
+                  "deferred to decode-side block frees",
+            scope=scope, replica=self.replica,
+            request_ids=[r.id for r in deferred],
+            deferred=len(deferred),
+            cost_bytes=fc["cost_bytes"],
+            headroom_bytes=fc["headroom_bytes"],
+            basis=fc["basis"],
+        )
 
     def _run_compiled(self, fn, *args):
         """Invoke a compiled program under the mesh context: inside
@@ -1394,6 +1481,7 @@ class ContinuousScheduler:
             self.tracer.record(req.id, "admitted")
         for req in reversed(deferred):
             self.queue.requeue(req)
+        self._note_block_pressure(exhausted, deferred)
         if not planned:
             return False
         groups: Dict[int, list] = {}
@@ -1702,6 +1790,7 @@ class ContinuousScheduler:
             # Fresh host-side buffers: re-pin them to the mesh, or the next
             # compiled call would recompile against replicated layouts.
             self._place_device_state()
+            self._account_device_state()
             self.pool.take_invalidations()
             return True
         if self.breakers is not None:

@@ -143,6 +143,19 @@ from fairness_llm_tpu.telemetry.incidents import (
     use_incident_manager,
     validate_incidents,
 )
+from fairness_llm_tpu.telemetry.memory import (
+    MemoryLedger,
+    POOLS,
+    aot_memory_capture_on,
+    get_memory_ledger,
+    has_memory_data,
+    render_memory_report,
+    set_aot_memory_capture,
+    set_memory_ledger,
+    set_memory_obs,
+    tree_device_bytes,
+    use_memory_ledger,
+)
 
 # -- process-wide event sink --------------------------------------------------
 # One sink per process, installed by the CLI when --telemetry-dir is set
@@ -190,6 +203,10 @@ def configure(telemetry_dir: str,
                    else EVENTS_MAX_BYTES),
     )
     install_event_sink(sink)
+    # Exported runs also arm the per-program AOT memory capture (memory.py):
+    # it costs one extra XLA compile per program, which a run that stands up
+    # the exporters has signed up for — bare library/test use stays free.
+    set_aot_memory_capture(True)
     return sink
 
 
@@ -278,4 +295,15 @@ __all__ = [
     "record_decision",
     "render_incident_report",
     "validate_incidents",
+    "MemoryLedger",
+    "POOLS",
+    "get_memory_ledger",
+    "set_memory_ledger",
+    "use_memory_ledger",
+    "set_memory_obs",
+    "set_aot_memory_capture",
+    "aot_memory_capture_on",
+    "tree_device_bytes",
+    "has_memory_data",
+    "render_memory_report",
 ]

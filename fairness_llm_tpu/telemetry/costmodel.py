@@ -550,6 +550,8 @@ class InstrumentedJit:
         self.program = program
         self.ledger: Optional[CostLedger] = None
         self._ledger_failed = False
+        self._memory_done = False
+        self._memory_failed = False
         self._collectives = list(collectives or ())
 
     def __call__(self, *args):
@@ -570,6 +572,27 @@ class InstrumentedJit:
                 self._ledger_failed = True
                 logger.warning("cost ledger for %s unavailable: %s: %s",
                                self.program, type(e).__name__, e)
+        if not self._memory_done and not self._memory_failed:
+            # AOT memory_analysis capture (ISSUE 18): what XLA itself
+            # budgeted for this program — temp/argument/output/peak — as
+            # program_memory_bytes gauges. Costs a second compile, so the
+            # capture flag stays off unless the exporters armed it; like
+            # the walk above it runs before the jitted call (donation) and
+            # inside the caller's mesh context (tp programs lower SPMD).
+            from fairness_llm_tpu.telemetry.memory import (  # lazy: no cycle
+                aot_memory_capture_on, capture_program_memory,
+            )
+
+            if aot_memory_capture_on():
+                try:
+                    capture_program_memory(self._jit, self._pyfn,
+                                           self.program, args)
+                    self._memory_done = True
+                except Exception as e:  # noqa: BLE001 — diagnostics only
+                    self._memory_failed = True
+                    logger.warning(
+                        "AOT memory analysis for %s unavailable: %s: %s",
+                        self.program, type(e).__name__, e)
         return self._jit(*args)
 
 

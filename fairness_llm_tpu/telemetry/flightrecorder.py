@@ -52,7 +52,7 @@ from typing import Deque, Dict, Optional
 from fairness_llm_tpu.telemetry.timeline import attribution_on
 
 RING_CATEGORIES = ("chunks", "transitions", "lifecycle", "roofline",
-                   "decisions", "routes")
+                   "decisions", "routes", "memory")
 
 DEFAULT_RING_CAPACITY = 512
 

@@ -104,6 +104,7 @@ INCIDENT_CLASSES = (
     "slo_burn",
     "integrity_fault",
     "heartbeat_gap",
+    "memory_pressure",   # paged-arena exhaustion deferred admissions
 )
 
 # Per-decision-kind JSONL emission throttle: the ring keeps the complete

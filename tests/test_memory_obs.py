@@ -1,0 +1,357 @@
+"""HBM memory ledger tests (ISSUE 18, telemetry/memory.py).
+
+The accounting contract: every pool gauge equals the hand-computed nbytes
+of the live device tree it claims to describe (contiguous cache, paged
+arena, carried logits, params, prefix-KV LRU entries — and the per-shard
+split on a tp mesh), registration/release/rebuild conserve the total, the
+headroom forecaster's arithmetic is exact against an injected analytic
+limit, arena exhaustion produces exactly one deduplicated
+``memory_pressure`` bundle naming the deferring requests, attribution-off
+records nothing, and the ``--require-memory`` validator gate accepts a
+real run and rejects a stripped one.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fairness_llm_tpu.config import MeshConfig, ModelSettings, ServingConfig
+from fairness_llm_tpu.models.configs import get_model_config
+from fairness_llm_tpu.parallel import make_mesh
+from fairness_llm_tpu.runtime.engine import DecodeEngine
+from fairness_llm_tpu.serving import ContinuousScheduler, Request
+from fairness_llm_tpu.telemetry import (
+    set_aot_memory_capture,
+    set_attribution,
+    snapshot,
+    use_flight_recorder,
+    use_incident_manager,
+    use_registry,
+    use_timeline,
+)
+import fairness_llm_tpu.telemetry as T
+from fairness_llm_tpu.telemetry.memory import (
+    MemoryLedger,
+    use_memory_ledger,
+)
+
+
+def _tool(name):
+    sys.path.insert(0, "/root/repo/tools")
+    try:
+        import importlib
+
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def greedy(m: int) -> ModelSettings:
+    return ModelSettings(temperature=0.0, max_tokens=m)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return DecodeEngine(get_model_config("tiny-test"), seed=0)
+
+
+# -- pool accounting oracles ---------------------------------------------------
+
+
+def test_register_release_conservation():
+    """Alloc/release/rebuild conservation: the ledger total is exactly the
+    sum of live entries, re-registering a handle REPLACES (rebuild
+    semantics), and a drained pool's gauge reads 0 rather than going
+    stale."""
+    with use_registry() as reg, use_memory_ledger() as mem:
+        a = jnp.zeros((8, 16), jnp.float32)   # 512 B
+        b = jnp.zeros((4,), jnp.int32)        # 16 B
+        assert mem.register("kv_contiguous", "t:a", a) == 8 * 16 * 4
+        assert mem.register("logits_carry", "t:b", b) == 16
+        assert mem.total_bytes() == 512 + 16
+        assert reg.read_value("hbm_bytes", component="memory",
+                              pool="kv_contiguous") == 512
+        # Rebuild: same handle, twice the array — replaced, not added.
+        assert mem.register("kv_contiguous", "t:a",
+                            jnp.zeros((16, 16), jnp.float32)) == 1024
+        assert mem.pool_bytes("kv_contiguous") == 1024
+        assert mem.total_bytes() == 1024 + 16
+        # Release drains to zero and the published gauge follows.
+        assert mem.release("kv_contiguous", "t:a") == 1024
+        assert mem.release("logits_carry", "t:b") == 16
+        assert mem.total_bytes() == 0
+        assert reg.read_value("hbm_bytes", default=-1.0, component="memory",
+                              pool="kv_contiguous") == 0.0
+        # Double release is a no-op, not an error.
+        assert mem.release("kv_contiguous", "t:a") == 0
+        # Unknown pools fail loudly — closed set, like incident classes.
+        with pytest.raises(ValueError):
+            mem.register("vram", "t:x", a)
+
+
+def test_contiguous_cache_oracle(engine):
+    """hbm_bytes{pool=kv_contiguous} equals the hand-computed bytes of the
+    slot cache: layers x (k, v) x [num_slots, cache_len, n_kv, head_dim]
+    f32 — and logits_carry equals num_slots x vocab x 4."""
+    cfg = engine.config
+    with use_registry() as reg, use_memory_ledger() as mem:
+        sched = ContinuousScheduler(engine, ServingConfig(
+            enabled=True, num_slots=2, max_prompt_len=64, max_new_tokens=16,
+        ), settings=greedy(8))
+        L = sched.cache_len
+        expect_kv = (cfg.num_layers * 2 * 2 * L
+                     * cfg.num_kv_heads * cfg.head_dim * 4  # k/v planes, f32
+                     + 2 * L * 1    # key_valid, bool
+                     + 2 * L * 4    # key_positions, int32
+                     + 4            # index, scalar int32
+                     + 2 * 4)       # lengths, int32 per slot
+        assert mem.pool_bytes("kv_contiguous") == expect_kv
+        assert mem.pool_bytes("logits_carry") == 2 * cfg.vocab_size * 4
+        assert reg.read_value("hbm_bytes", component="memory",
+                              pool="kv_contiguous") == expect_kv
+
+
+def test_paged_arena_oracle(engine):
+    """hbm_bytes{pool=kv_paged} equals the hand-computed arena bytes:
+    per layer k/v [N, bs, n_kv, head_dim] f32 plus the validity (bool) and
+    position (int32) planes plus per-slot lengths."""
+    cfg = engine.config
+    with use_registry(), use_memory_ledger() as mem:
+        sched = ContinuousScheduler(engine, ServingConfig(
+            enabled=True, num_slots=2, max_prompt_len=64, max_new_tokens=16,
+            paged_kv=True, kv_block_size=16,
+        ), settings=greedy(8))
+        N = sched.pool.paged.num_blocks
+        bs = 16
+        expect = (cfg.num_layers * 2 * N * bs * cfg.num_kv_heads
+                  * cfg.head_dim * 4     # k/v planes, f32
+                  + N * bs * 1           # key_valid, bool
+                  + N * bs * 4           # key_positions, int32
+                  + 2 * 4)               # lengths, int32 per slot
+        assert mem.pool_bytes("kv_paged") == expect
+        # The forecaster's per-block price derives from the same tree.
+        assert sched._block_bytes == expect // N
+
+
+def test_params_pool_and_rebuild():
+    """Engine construction registers the param tree; the handle is stable,
+    so re-running the preflight (the rebuild path) replaces rather than
+    double-counts."""
+    with use_registry() as reg, use_memory_ledger() as mem:
+        eng = DecodeEngine(get_model_config("tiny-test"), seed=0)
+        expect = sum(int(x.nbytes) for x in
+                     jax.tree_util.tree_leaves(eng.params))
+        assert mem.pool_bytes("params") == expect
+        eng._account_params_memory()  # what the VMEM-fallback rebuild runs
+        assert mem.pool_bytes("params") == expect
+        assert reg.read_value("hbm_bytes_total", component="memory",
+                              reconciliation="indicative") == \
+            mem.total_bytes()
+
+
+def test_tp2_shard_split():
+    """On a tp=2 mesh a sharded tree publishes per-device hbm_bytes rows
+    under shard=tp<id> labels, and the split sums to the per-shard
+    bytes."""
+    mesh = make_mesh(MeshConfig(tp=2))
+    spec = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("tp", None))
+    x = jax.device_put(jnp.zeros((8, 16), jnp.float32), spec)
+    with use_registry() as reg, use_memory_ledger() as mem:
+        assert mem.register("kv_contiguous", "t:x", x) == 512
+        shards = {d.id: 0 for sh in [x.addressable_shards] for s in sh
+                  for d in [s.device]}
+        assert len(shards) == 2
+        total = 0
+        for did in shards:
+            v = reg.read_value("hbm_bytes", default=-1.0,
+                               component="memory", pool="kv_contiguous",
+                               shard=f"tp{did}")
+            assert v == 256  # half of the 512 B array per device
+            total += v
+        assert total == 512
+
+
+def test_prefix_kv_lru_instrumented(engine):
+    """The engine's prefix-KV LRU registers each cached entry under
+    pool=prefix_cache, counts evictions, and keeps the entry gauge at the
+    working-set cap."""
+    g = greedy(4)
+    with use_registry() as reg, use_memory_ledger() as mem:
+        for i in range(6):  # 6 distinct prefixes > the LRU's 4-entry cap
+            common = f"shared instruction block {i} " * 8
+            engine.generate([common + "user a", common + "user b"], g,
+                            share_prefix=True)
+        assert mem.pool_bytes("prefix_cache") > 0
+        assert reg.read_value("prefix_kv_entries",
+                              component="engine") <= 4
+        assert reg.read_value("prefix_kv_evictions_total",
+                              component="engine") >= 1
+
+
+# -- reconciliation / forecast -------------------------------------------------
+
+
+def test_headroom_forecast_math():
+    with use_registry() as reg, use_memory_ledger() as mem:
+        # No limit known: forecast abstains, it never guesses.
+        fc = mem.forecast(1000)
+        assert fc["basis"] is None and fc["fits"] is None
+        assert mem.headroom_frac() is None
+        mem.register("other", "t:a", jnp.zeros((256,), jnp.float32))  # 1 KiB
+        mem.set_analytic_limit(10_240)
+        fc = mem.forecast(2_048)
+        assert fc["basis"] == "indicative"
+        assert fc["headroom_bytes"] == 10_240 - 1_024
+        assert fc["fits"] is True
+        assert fc["headroom_after_frac"] == pytest.approx(
+            (10_240 - 1_024 - 2_048) / 10_240)
+        assert mem.headroom_frac() == pytest.approx(9_216 / 10_240)
+        assert mem.forecast(9_217)["fits"] is False
+        assert reg.read_value("hbm_bytes_limit", component="memory",
+                              reconciliation="indicative") == 10_240
+        assert reg.read_value("hbm_headroom_bytes", component="memory",
+                              reconciliation="indicative") == 9_216
+        # CPU reports no memory_stats, so no measured delta and no alerts.
+        assert reg.read_value("hbm_reconciliation_alerts_total",
+                              component="memory") == 0
+
+
+def test_attribution_off_records_nothing():
+    prev = set_attribution(True)
+    try:
+        with use_registry() as reg, use_memory_ledger() as mem:
+            set_attribution(False)
+            assert mem.register("other", "t:a",
+                                jnp.zeros((64,), jnp.float32)) == 0
+            assert mem.total_bytes() == 0
+            mem.note_pressure("serving", True)
+            assert not any(
+                getattr(m, "name", "").startswith(("hbm_", "memory_"))
+                for m in reg.instruments()
+            )
+    finally:
+        set_attribution(prev)
+
+
+# -- memory pressure -----------------------------------------------------------
+
+
+def test_arena_exhaustion_fires_one_bundle(engine, tmp_path):
+    """A scarce paged arena defers admissions (the pre-existing hard
+    gate), and the ledger turns that into exactly ONE deduplicated
+    memory_pressure bundle naming the deferring requests — with the
+    recoverable memory_pressure_active gauge back at 0 once the drain
+    completes."""
+    probe = ContinuousScheduler(engine, ServingConfig(
+        enabled=True, num_slots=2, max_prompt_len=192, max_new_tokens=32,
+        decode_chunk=4, paged_kv=True, kv_block_size=16,
+    ), settings=greedy(8))
+    scarce = probe.pool.paged.blocks_per_slot + 2
+    del probe
+    cfg = ServingConfig(
+        enabled=True, num_slots=2, max_prompt_len=192, max_new_tokens=32,
+        decode_chunk=4, paged_kv=True, kv_block_size=16, kv_blocks=scarce,
+    )
+    stem = ("recommend five movies for a user who enjoyed Alien, Heat, "
+            "Fargo, Tron and likes thrillers; profile ")
+    fam = [stem + t for t in ("male 18-24", "female 18-24", "male 25-34",
+                              "female 25-34")]
+    with use_registry() as reg, use_timeline(), use_memory_ledger() as mem, \
+            use_flight_recorder() as rec, use_incident_manager() as mgr:
+        mgr.arm(str(tmp_path / "incidents"))
+        sched = ContinuousScheduler(engine, cfg, settings=greedy(8))
+        mem.set_analytic_limit(mem.total_bytes() + (16 << 20))
+        res = sched.serve([Request(prompt=p, id=f"mem{i}",
+                                   settings=greedy(8))
+                           for i, p in enumerate(fam)])
+        assert all(r.ok for r in res)
+        bundles = T.list_bundles(str(tmp_path / "incidents"))
+        mem_bundles = [b for b in bundles if b["class"] == "memory_pressure"]
+        assert len(mem_bundles) == 1
+        named = (mem_bundles[0].get("context") or {}).get("request_ids")
+        assert named and all(str(r).startswith("mem") for r in named)
+        assert mem_bundles[0]["context"]["headroom_bytes"] is not None
+        # Recoverable: pressure cleared once admission succeeded again.
+        assert reg.read_value("memory_pressure_active", default=-1.0,
+                              component="memory", replica="serving") == 0.0
+        # The flight recorder's memory ring saw the pressure transition.
+        assert any(e.get("event") == "pressure"
+                   for e in rec.rings["memory"])
+
+
+# -- validator gate / CLI ------------------------------------------------------
+
+
+def _serve_with_memory_obs(engine, mem):
+    """A small serving run with the AOT capture armed — what a
+    --telemetry-dir run records (telemetry.configure arms the flag)."""
+    engine._account_params_memory()  # fixture engine predates this ledger
+    prev = set_aot_memory_capture(True)
+    try:
+        sched = ContinuousScheduler(engine, ServingConfig(
+            enabled=True, num_slots=2, max_prompt_len=64, max_new_tokens=8,
+        ), settings=greedy(8))
+        res = sched.serve([Request(prompt=p, settings=greedy(8))
+                           for p in ("hello there", "quick brown fox",
+                                     "one two three")])
+        assert all(r.ok for r in res)
+    finally:
+        set_aot_memory_capture(prev)
+
+
+def test_validate_require_memory_accept_reject(engine, tmp_path):
+    from fairness_llm_tpu.telemetry import write_snapshot
+
+    check = _tool("validate_telemetry").check
+    with use_registry() as reg, use_timeline(), use_memory_ledger() as mem:
+        _serve_with_memory_obs(engine, mem)
+        write_snapshot(reg, str(tmp_path))
+        assert check(str(tmp_path), require_memory=True) == 0
+    # Same snapshot with the AOT program gauges stripped must fail: every
+    # program in compiles_total owes a program_memory_bytes row.
+    snap = json.load(open(tmp_path / "telemetry_snapshot.json"))
+    bad1 = dict(snap)
+    bad1["gauges"] = [g for g in snap["gauges"]
+                      if g["name"] != "program_memory_bytes"]
+    p1 = tmp_path / "bad_programs.json"
+    p1.write_text(json.dumps(bad1))
+    assert check(str(p1), require_memory=True) == 1
+    # And with the pool gauges stripped too (no ledger at all).
+    bad2 = dict(snap)
+    bad2["gauges"] = [g for g in snap["gauges"]
+                      if not g["name"].startswith("hbm_")]
+    p2 = tmp_path / "bad_pools.json"
+    p2.write_text(json.dumps(bad2))
+    assert check(str(p2), require_memory=True) == 1
+
+
+def test_cli_memory_report(engine, tmp_path, capsys):
+    from fairness_llm_tpu.cli.main import main as cli_main
+    from fairness_llm_tpu.telemetry import write_snapshot
+
+    with use_registry() as reg, use_timeline(), use_memory_ledger() as mem:
+        _serve_with_memory_obs(engine, mem)
+        mem.set_analytic_limit(mem.total_bytes() + (32 << 20))
+        write_snapshot(reg, str(tmp_path))
+    assert cli_main(["memory-report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "HBM memory ledger" in out
+    assert "indicative" in out            # CPU: analytic-only labeling
+    assert "kv_contiguous" in out
+    assert "per-program AOT memory" in out
+    # telemetry-report appends the same section when memory data exists.
+    assert cli_main(["telemetry-report", str(tmp_path)]) == 0
+    assert "HBM memory ledger" in capsys.readouterr().out
+    # --require-ledger on an empty snapshot fails.
+    empty = tmp_path / "empty"
+    with use_registry() as reg2:
+        from fairness_llm_tpu.telemetry import write_snapshot as ws
+
+        ws(reg2, str(empty))
+    assert cli_main(["memory-report", str(empty),
+                     "--require-ledger"]) == 1

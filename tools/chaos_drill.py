@@ -719,6 +719,46 @@ def main() -> int:
           "fused chaos: the dispatch compiled under the fused key "
           "(chunk, guard, fuse)")
 
+    # 11. Memory-pressure drill (ISSUE 18, telemetry/memory.py): the same
+    # scarce arena as section 9 under its OWN incident scope
+    # (replica="memdrill" — section 9's exhaustion already owns the
+    # default "serving" dedup key) with an injected analytic HBM budget
+    # (CPU reports no memory_stats; reconciliation stays "indicative").
+    # Admission demand beyond the arena must defer exactly as before —
+    # the allocator stays the hard gate — while the ledger (a) fires
+    # EXACTLY ONE deduplicated memory_pressure bundle naming the
+    # deferring requests and (b) drops the recoverable
+    # memory_pressure_active gauge back to 0 once decode frees blocks
+    # and admission succeeds.
+    from fairness_llm_tpu.telemetry.memory import get_memory_ledger
+
+    mem = get_memory_ledger()
+    mem_sched = ContinuousScheduler(engine, paged_cfg, settings=GREEDY,
+                                    replica="memdrill")
+    mem.set_analytic_limit(mem.total_bytes() + (64 << 20))
+    mem_res = {r.id: r for r in mem_sched.serve(
+        [Request(prompt=p, id=f"mem{i}", settings=GREEDY)
+         for i, p in enumerate(fam)]
+    )}
+    check(len(mem_res) == len(fam) and all(r.ok for r in mem_res.values()),
+          "memory drill: zero lost under scarce-arena pressure")
+    mem_bundles = bundles("memory_pressure", scope="memdrill")
+    check(len(mem_bundles) == 1,
+          "memory drill: exactly one deduplicated memory_pressure bundle "
+          f"({len(mem_bundles)} found)")
+    named = ((mem_bundles[0].get("context") or {}).get("request_ids")
+             if mem_bundles else None) or []
+    check(bool(named) and all(str(r).startswith("mem") for r in named),
+          f"memory drill: bundle names the deferring requests ({named})")
+    check(reg.read_value("memory_pressure_active", default=-1.0,
+                         component="memory", replica="memdrill") == 0.0,
+          "memory drill: memory_pressure_active recovered to 0 at drain")
+    check(reg.read_value("hbm_headroom_bytes", component="memory",
+                         reconciliation="indicative") > 0,
+          "memory drill: headroom gauge published against the analytic "
+          "budget (indicative)")
+    mem.set_analytic_limit(None)
+
     snap = T.snapshot(T.get_registry())
     # Unlabeled entries only: the fleet section's per-replica boards write
     # breaker_transitions_total{replica=...} rows for the SAME (stage, to)

@@ -104,6 +104,7 @@ def check(path: str, require_serving: bool = False,
           require_autoscale: bool = False,
           require_costmodel: bool = False,
           require_incidents: bool = False,
+          require_memory: bool = False,
           forbid_incidents: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
@@ -115,6 +116,8 @@ def check(path: str, require_serving: bool = False,
         problems.extend(_check_profile(path, snap))
     if require_costmodel:
         problems.extend(_check_costmodel(snap))
+    if require_memory:
+        problems.extend(_check_memory(snap))
     if require_fairness:
         problems.extend(_check_fairness(snap))
     if require_autoscale:
@@ -408,6 +411,77 @@ def _check_costmodel(snap: dict) -> list:
     return problems
 
 
+def _check_memory(snap: dict) -> list:
+    """The --require-memory gate (ISSUE 18): the HBM memory ledger
+    accounted real pools, every compiled program seen in ``compiles_total``
+    (including ``*_fused`` and ``@tpN``) published its AOT
+    ``program_memory_bytes``, and where a device limit exists the ledger
+    total respects it."""
+    problems = []
+    gauges = snap.get("gauges", [])
+    # Pool residency: at least the params pool plus one KV pool must be
+    # nonzero — a serving run that allocated neither accounted nothing.
+    pool_bytes = {}
+    for g in gauges:
+        lb = g.get("labels", {})
+        if g.get("name") != "hbm_bytes" or "shard" in lb:
+            continue
+        pool = lb.get("pool")
+        pool_bytes[pool] = pool_bytes.get(pool, 0.0) + float(
+            g.get("value", 0.0))
+    if pool_bytes.get("params", 0.0) <= 0:
+        problems.append("hbm_bytes{pool=params} is zero or absent (no "
+                        "engine ever registered its param tree)")
+    if (pool_bytes.get("kv_contiguous", 0.0) <= 0
+            and pool_bytes.get("kv_paged", 0.0) <= 0):
+        problems.append("neither hbm_bytes{pool=kv_contiguous} nor "
+                        "{pool=kv_paged} is nonzero (no scheduler ever "
+                        "registered its KV state)")
+    # Ledger total vs limit: where a limit exists (device-reported or
+    # injected analytic), the accounted total must fit under it.
+    total = sum(float(g.get("value", 0.0)) for g in gauges
+                if g.get("name") == "hbm_bytes_total")
+    limit = sum(float(g.get("value", 0.0)) for g in gauges
+                if g.get("name") == "hbm_bytes_limit")
+    if total <= 0:
+        problems.append("hbm_bytes_total is zero or absent (the ledger "
+                        "never reconciled)")
+    if limit > 0 and total > limit:
+        problems.append(
+            f"ledger total {total:.0f} B exceeds the HBM limit "
+            f"{limit:.0f} B (the accounting claims more memory than the "
+            "device has)"
+        )
+    # Per-program AOT memory: every program compiled this run must have
+    # published its memory_analysis — same every-program contract as the
+    # cost ledger, and the @tpN / *_fused labels get no exemption (each
+    # label IS its own compiled program).
+    compiled = sorted({
+        c.get("labels", {}).get("program")
+        for c in snap.get("counters", [])
+        if c.get("name") == "compiles_total" and c.get("value")
+    } - {None})
+    if not compiled:
+        problems.append("compiles_total is empty (no compiled program to "
+                        "require AOT memory analysis for)")
+    prog_kinds = {}
+    for g in gauges:
+        if g.get("name") != "program_memory_bytes":
+            continue
+        lb = g.get("labels", {})
+        prog_kinds.setdefault(lb.get("program"), set()).add(lb.get("kind"))
+    for prog in compiled:
+        kinds = prog_kinds.get(prog, set())
+        missing = {"argument", "output", "temp"} - kinds
+        if missing:
+            problems.append(
+                f"compiled program {prog!r} missing program_memory_bytes "
+                f"kinds {sorted(missing)} (AOT memory_analysis never "
+                "captured for it)"
+            )
+    return problems
+
+
 def _check_autoscale(snap: dict) -> list:
     """The --require-autoscale gate (ISSUE 11): a full elastic cycle
     (scale-up AND scale-down), zero accepted-then-lost across the replay,
@@ -683,6 +757,7 @@ def main() -> int:
     ap.add_argument("--require-autoscale", action="store_true")
     ap.add_argument("--require-costmodel", action="store_true")
     ap.add_argument("--require-incidents", action="store_true")
+    ap.add_argument("--require-memory", action="store_true")
     ap.add_argument("--forbid-incidents", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
@@ -696,6 +771,7 @@ def main() -> int:
                  require_autoscale=a.require_autoscale,
                  require_costmodel=a.require_costmodel,
                  require_incidents=a.require_incidents,
+                 require_memory=a.require_memory,
                  forbid_incidents=a.forbid_incidents)
 
 
