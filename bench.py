@@ -45,7 +45,8 @@ V5E_HBM_GBPS = 819.0  # v5e spec HBM bandwidth — the decode roofline reference
 
 _ALL_ENTRIES = (
     "speculative", "continuous", "resilience", "integrity", "profiling",
-    "fused_decode", "serve_tp", "incidents", "memory", "fleet", "overload",
+    "fused_decode", "serve_tp", "incidents", "memory", "rollout", "fleet",
+    "overload",
     "fairness", "prefix_cache", "capacity", "large_sweep", "phase2_listwise",
     "flash_proof", "int8_70b", "shard70b", "live8b",
 )
@@ -177,6 +178,10 @@ def baseline_entries(result: dict) -> dict:
     mo = d.get("memory_overhead")
     if mo:
         wall("memory.overhead_ratio", mo.get("overhead_ratio"),
+             better="lower")
+    ro = d.get("rollout_overhead")
+    if ro:
+        wall("rollout.overhead_ratio", ro.get("overhead_ratio"),
              better="lower")
     fd = d.get("fused_decode")
     if fd:
@@ -1036,6 +1041,99 @@ def measure_memory_overhead(engine, prompts, settings_cls) -> dict | None:
     finally:
         set_aot_memory_capture(prev_aot)
     assert tokens["on"] == tokens["off"], "memory ledger changed output"
+    out["overhead_ratio"] = round(
+        out["on"]["wall_s"] / out["off"]["wall_s"], 3
+    )
+    return out
+
+
+def measure_rollout_overhead(engine, prompts, settings_cls) -> dict | None:
+    """Armed-idle rollout controller vs none attached (PR 20).
+
+    The version axis's steady-state cost when NO wave is in flight is
+    pure hot-path bookkeeping: per-submit version stamping (the
+    pinned-affinity map), the router's version filter short-circuit, and
+    the fleet tick's ``rollout.active`` probe. A/B: the same mixed
+    workload through identical 2-replica fleets, one bare, one with a
+    :class:`RolloutController` constructed but never started. Target:
+    within the CPU harness's run-to-run noise (best-of-3 per mode, per
+    docs/PERFORMANCE.md methodology), token parity asserted, and the
+    armed mode must record ZERO rollout transitions — armed means armed,
+    not creeping.
+    """
+    from fairness_llm_tpu.config import (
+        FleetConfig,
+        ResilienceConfig,
+        RolloutConfig,
+        ServingConfig,
+        default_config,
+    )
+    from fairness_llm_tpu.serving import ReplicaSet, Request, RolloutController
+    from fairness_llm_tpu.telemetry import use_registry, use_timeline
+
+    num_slots = max(default_config().decode_batch_size, 2)
+    per_replica = max(num_slots // 2, 1)
+    n_requests = 2 * num_slots
+    budgets = [16, 32, 48, 64]
+    workload = _mixed_workload(engine, prompts, n_requests,
+                               targets=[32, 64, 128, 256], budgets=budgets)
+
+    def greedy(m):
+        return _greedy(settings_cls, m)
+
+    scfg = ServingConfig(
+        enabled=True, num_slots=per_replica, max_prompt_len=512,
+        max_new_tokens=max(budgets), decode_chunk=8,
+    )
+    res = ResilienceConfig(enabled=True, breaker_threshold=3,
+                           breaker_cooldown_s=0.05)
+
+    def run(fleet, tag):
+        reqs = [
+            Request(prompt=p, id=f"ro_{tag}_{i:04d}", settings=greedy(b))
+            for i, (p, b) in enumerate(workload)
+        ]
+        t0 = time.perf_counter()
+        results = fleet.serve(reqs)
+        wall = time.perf_counter() - t0
+        assert all(r.ok for r in results)
+        toks = [tuple(int(t) for t in r.tokens) for r in results]
+        return wall, toks
+
+    out = {"num_requests": n_requests, "replicas": 2,
+           "slots_per_replica": per_replica}
+    tokens = {}
+    for tag, armed in (("off", False), ("on", True)):
+        # Fresh registry/timeline per mode so the zero-transition check
+        # reads exactly this fleet's instruments.
+        with use_registry() as reg, use_timeline():
+            fleet = ReplicaSet(engine, scfg, settings=greedy(max(budgets)),
+                               fleet=FleetConfig(replicas=2),
+                               resilience=res)
+            ro = None
+            if armed:
+                ro = RolloutController(
+                    fleet, "v1", engine=engine,
+                    config=RolloutConfig(enabled=True),
+                )  # constructed, never started: armed-idle
+            run(fleet, tag)  # warmup: compile prefill buckets + steps
+            wall, toks = min((run(fleet, tag) for _ in range(3)),
+                             key=lambda r: r[0])
+            tokens[tag] = toks
+            total = sum(len(t) for t in toks)
+            out[tag] = {
+                "wall_s": round(wall, 3),
+                "tokens_per_sec": round(total / wall, 1),
+            }
+            if armed:
+                assert ro.state == "idle", "armed-idle controller moved"
+                transitions = sum(
+                    m.value for m in reg.instruments()
+                    if getattr(m, "name", "") == "rollout_transitions_total"
+                )
+                assert transitions == 0, \
+                    "armed-idle rollout recorded transitions"
+    assert tokens["on"] == tokens["off"], "armed rollout changed output"
     out["overhead_ratio"] = round(
         out["on"]["wall_s"] / out["off"]["wall_s"], 3
     )
@@ -2199,6 +2297,18 @@ def _run(baseline_out: "str | None" = None) -> None:
         print(f"memory overhead A/B skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
 
+    # Armed-idle rollout overhead guard (PR 20): 2-replica fleet with a
+    # constructed-but-idle RolloutController vs none — within harness
+    # noise, token parity asserted, zero transitions recorded.
+    rollout = None
+    try:
+        if _enabled("rollout"):
+            rollout = measure_rollout_overhead(engine, prompts,
+                                               ModelSettings)
+    except Exception as e:  # noqa: BLE001 — auxiliary measurement only
+        print(f"rollout overhead A/B skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
     # Replica-fleet A/B (ISSUE 6): 2-replica health-routed fleet vs a
     # single scheduler at the same total slot count (router overhead must
     # stay within harness noise), plus failover recovery time under an
@@ -2604,6 +2714,7 @@ def _run(baseline_out: "str | None" = None) -> None:
             "serve_tp": serve_tp,
             "incident_overhead": incidents,
             "memory_overhead": memory,
+            "rollout_overhead": rollout,
             "fleet": fleet,
             "overload_overhead": overload,
             "fairness_overhead": fairness,

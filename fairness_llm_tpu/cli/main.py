@@ -681,6 +681,14 @@ def telemetry_report(argv) -> int:
         from fairness_llm_tpu.telemetry import render_fairness_report
 
         print("\n" + render_fairness_report(snap))
+    from fairness_llm_tpu.serving.rollout import render_rollout_report
+
+    rollout_section = render_rollout_report(snap)
+    if rollout_section:
+        # Rollout section rides along whenever the run drove a version
+        # rollout (cli rollout / tools/rollout_drill.py): wave position,
+        # traffic split, transition and rollback-cause tallies.
+        print("\n" + rollout_section)
     if a.timeline:
         trace_dir = a.path if os.path.isdir(a.path) else os.path.dirname(a.path)
         trace_path = os.path.join(trace_dir, TRACE_FILENAME)
@@ -1004,6 +1012,198 @@ def resume_serving_cmd(argv) -> int:
     return 1 if still else 0
 
 
+def rollout_cmd(argv) -> int:
+    """``cli rollout`` — zero-downtime rolling version upgrade.
+
+    Builds a ``ReplicaSet`` on the current model/weights, then walks it
+    to a new immutable version with a :class:`RolloutController` while a
+    synthetic workload streams through the fleet: one canary-gated
+    standby per wave, stepped traffic shift, planned retirement of each
+    old replica, automatic rollback on any deployment gate (manifest
+    refusal of the incoming checkpoint, canary mismatch, SLO error burn,
+    fairness alert / counterfactual pair divergence attributed to the
+    new version, watchdog or breaker trip). Requests keep pinned-version
+    affinity throughout: a stream finishes on the version that served
+    its first token. See docs/SERVING.md §Rollouts.
+
+    Exit status: 0 = rollout complete; 2 = rolled back (the gate and
+    cause are printed and, with ``--telemetry-dir``, bundled under
+    incidents/); 1 = requests lost (never expected — file a bug).
+    """
+    ap = argparse.ArgumentParser(
+        prog="fairness_llm_tpu rollout",
+        description="Drive a canary-gated rolling upgrade across a "
+                    "replica fleet under live traffic",
+    )
+    ap.add_argument("--model", required=True,
+                    help="engine model name for the CURRENT version")
+    ap.add_argument("--weights-dir", default=None,
+                    help="HF safetensors dir for the current version")
+    ap.add_argument("--to-checkpoint", default=None, metavar="DIR",
+                    help="HF safetensors dir for the NEW version's weights "
+                         "(manifest-verified during PREPARING; a refused "
+                         "checkpoint rolls back before any replica joins)")
+    ap.add_argument("--to-config", default=None, metavar="MODEL",
+                    help="model config name for the new version "
+                         "(default: --model)")
+    ap.add_argument("--to-version", default=None, metavar="ID",
+                    help="immutable version id for the new fleet "
+                         "(default: bump the current one, v0 -> v1)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--canary-window", type=float, default=None,
+                    metavar="S",
+                    help="gate-watch window per traffic step (seconds)")
+    ap.add_argument("--traffic-steps", type=int, default=None, metavar="N",
+                    help="traffic-shift steps per wave")
+    ap.add_argument("--abort-on-fairness-alert",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="treat fairness alerts / counterfactual pair "
+                         "divergence attributed to the new version as a "
+                         "rollback gate (default: on)")
+    ap.add_argument("--requests", type=int, default=32,
+                    help="synthetic requests streamed during the rollout")
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--slo-ttft-p95", type=float, default=None, metavar="S",
+                    help="TTFT p95 target feeding the rollout's SLO burn "
+                         "gate (default: the stack default; set generously "
+                         "on CPU smoke runs or the gate will fire)")
+    ap.add_argument("--slo-e2e-p99", type=float, default=None, metavar="S")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("--allow-random", action="store_true",
+                    help="run with randomly initialized weights (smoke "
+                         "runs / drills only)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    a = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if a.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if not a.weights_dir and not a.allow_random:
+        raise SystemExit("rollout needs --weights-dir (or --allow-random "
+                         "for smoke runs)")
+    import time
+
+    from fairness_llm_tpu.config import (
+        FleetConfig,
+        IntegrityConfig,
+        ModelSettings,
+        ResilienceConfig,
+        RolloutConfig,
+        ServingConfig,
+    )
+    from fairness_llm_tpu.models.configs import get_model_config
+    from fairness_llm_tpu.runtime.engine import DecodeEngine
+    from fairness_llm_tpu.runtime.weights import load_checkpoint
+    from fairness_llm_tpu.serving import ReplicaSet, Request, RolloutController
+    from fairness_llm_tpu.serving.replay import DEFAULT_PROMPTS
+
+    sink = None
+    if a.telemetry_dir:
+        from fairness_llm_tpu import telemetry as T
+
+        sink = T.configure(a.telemetry_dir)
+        T.arm_incidents(a.telemetry_dir)
+
+    if a.slo_ttft_p95 is not None or a.slo_e2e_p99 is not None:
+        from fairness_llm_tpu.telemetry.slo import SLOTargets, set_slo_targets
+
+        slo_kwargs = {}
+        if a.slo_ttft_p95 is not None:
+            slo_kwargs["ttft_p95_s"] = a.slo_ttft_p95
+        if a.slo_e2e_p99 is not None:
+            slo_kwargs["e2e_p99_s"] = a.slo_e2e_p99
+        set_slo_targets(SLOTargets(**slo_kwargs))
+
+    cfg = get_model_config(a.model)
+    engine = DecodeEngine(cfg, seed=a.seed)
+    if a.weights_dir:
+        engine.params = load_checkpoint(cfg, a.weights_dir)
+
+    to_cfg = get_model_config(a.to_config) if a.to_config else cfg
+
+    def new_engine():
+        # Built lazily inside the controller's PREPARING step so a
+        # manifest refusal of --to-checkpoint lands as a rollback gate,
+        # not a CLI traceback.
+        eng = DecodeEngine(to_cfg, seed=a.seed)
+        if a.to_checkpoint:
+            eng.params = load_checkpoint(to_cfg, a.to_checkpoint)
+        return eng
+
+    serving = ServingConfig(
+        enabled=True, num_slots=2, queue_capacity=max(16, a.requests),
+        max_new_tokens=min(a.max_new_tokens, cfg.max_seq_len // 2,
+                           to_cfg.max_seq_len // 2),
+    )
+    fleet = ReplicaSet(
+        engine, serving,
+        settings=ModelSettings(temperature=0.0,
+                               max_tokens=serving.max_new_tokens),
+        fleet=FleetConfig(replicas=a.replicas),
+        resilience=ResilienceConfig(enabled=True),
+        integrity=IntegrityConfig(),
+    )
+    ro_kwargs = {}
+    if a.canary_window is not None:
+        ro_kwargs["canary_window_s"] = a.canary_window
+    if a.traffic_steps is not None:
+        ro_kwargs["traffic_steps"] = a.traffic_steps
+    to_version = a.to_version or f"v{int(fleet.version.lstrip('v') or 0) + 1}"
+    ro = RolloutController(
+        fleet, to_version, engine_fn=new_engine,
+        config=RolloutConfig(
+            enabled=True,
+            abort_on_fairness_alert=a.abort_on_fairness_alert,
+            **ro_kwargs,
+        ),
+    )
+    from_version = fleet.version
+    ro.start()
+    pending = [
+        Request(prompt=DEFAULT_PROMPTS[i % len(DEFAULT_PROMPTS)],
+                id=f"ro_{i}", settings=fleet.settings)
+        for i in range(a.requests)
+    ]
+    results: Dict[str, object] = {}
+    outstanding: list = []
+    t0 = time.monotonic()
+    while (ro.active or pending or outstanding or fleet.has_work):
+        if time.monotonic() - t0 > 600.0:
+            print("rollout wall guard tripped (600 s) — aborting")
+            break
+        if pending and fleet.submit(pending[0]):
+            outstanding.append(pending.pop(0).id)
+        fleet.tick()
+        for rid in list(outstanding):
+            res = fleet.take_result(rid)
+            if res is not None:
+                results[rid] = res
+                outstanding.remove(rid)
+    pins: Dict[str, int] = {}
+    for rid in results:
+        ver = fleet.request_version(rid) or "?"
+        pins[ver] = pins.get(ver, 0) + 1
+    lost = a.requests - len(results)
+    print(f"rollout {from_version} -> {to_version}: state={ro.state}"
+          + (f" cause={ro.cause}" if ro.cause else ""))
+    print(f"served {len(results)}/{a.requests} request(s), pinned "
+          + (", ".join(f"{k}={v}" for k, v in sorted(pins.items()))
+             or "none"))
+    if a.telemetry_dir:
+        from fairness_llm_tpu import telemetry as T
+
+        path = T.write_snapshot(T.get_registry(), a.telemetry_dir)
+        print(f"telemetry snapshot: {path}")
+        if sink is not None:
+            T.install_event_sink(None)
+            sink.close()
+    if lost:
+        print(f"LOST {lost} request(s) — this is a bug")
+        return 1
+    return 0 if ro.state == "complete" else 2
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -1023,6 +1223,8 @@ def main(argv=None) -> int:
         return incident_report(argv[1:])
     if argv and argv[0] == "resume-serving":
         return resume_serving_cmd(argv[1:])
+    if argv and argv[0] == "rollout":
+        return rollout_cmd(argv[1:])
     args = build_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,

@@ -286,6 +286,53 @@ class AutoscaleConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RolloutConfig:
+    """Zero-downtime rolling-upgrade knobs (``serving/rollout.py``).
+
+    A :class:`RolloutController` drives a canary-gated wave upgrade over a
+    :class:`ReplicaSet`: per wave it adds ONE standby replica at the target
+    version (canary-gated through the fleet's rejoin probe — a v+1 replica
+    that cannot decode its own golden prompt never takes traffic), walks a
+    traffic fraction to the new version in ``traffic_steps`` increments
+    (version-aware ``HealthRouter`` steering), watches the deployment gates
+    for ``canary_window_s`` per step, then retires one old-version replica
+    through the planned-exit path — repeating until the fleet is entirely
+    on the new version. Requests carry **pinned-version affinity**: a
+    request completes on the version that admitted it (migration targets
+    the same version while one lives), so greedy token parity holds
+    per-version mid-rollout.
+
+    Any gate firing while new-version replicas exist triggers an
+    **automatic rollback**: canary mismatch on the new version, a fairness
+    alert or counterfactual pair divergence attributed to a new replica
+    (``abort_on_fairness_alert``), fast-window SLO error burn at/over
+    ``gate_burn_threshold`` on a new replica's label, manifest refusal of
+    the incoming weights, or a watchdog/breaker fence of a new replica —
+    the new replicas are re-fenced, their in-flight work migrates back,
+    and a ``rollout`` incident bundle names the triggering gate. While a
+    rollout is active the autoscaler is paused (one owner of replica
+    membership at a time). See docs/SERVING.md §Rollouts.
+    """
+
+    enabled: bool = False
+    # Gate-watch window per traffic step: how long the controller holds
+    # each traffic fraction while watching the deployment gates before
+    # advancing the wave.
+    canary_window_s: float = 1.0
+    # Traffic increments per wave: the new-version share walks from its
+    # previous plateau to the next in this many equal steps.
+    traffic_steps: int = 2
+    # Fast-window slo_burn_rate on a new-version replica's label at/over
+    # this triggers rollback (same scale as AutoscaleConfig thresholds).
+    gate_burn_threshold: float = 2.0
+    # Treat ANY fairness alert (and any counterfactual pair divergence
+    # whose attribution names a new-version replica) during the gate
+    # window as a rollback trigger — the FairnessMonitor as a deployment
+    # gate.
+    abort_on_fairness_alert: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ResilienceConfig:
     """Watchdog / circuit-breaker / graceful-drain knobs (``resilience/``).
 
@@ -511,6 +558,11 @@ class Config:
     autoscale: AutoscaleConfig = dataclasses.field(
         default_factory=AutoscaleConfig
     )
+    # Rolling upgrades: canary+fairness-gated wave rollouts over the fleet
+    # (`rollout` subcommand; needs --continuous --replicas). Off by
+    # default — the fleet is byte-identical without an active rollout.
+    # See docs/SERVING.md §Rollouts.
+    rollout: RolloutConfig = dataclasses.field(default_factory=RolloutConfig)
     # Resilience: step watchdog + per-stage circuit breakers + graceful
     # drain/journal (off by default; --max-step-seconds/--serving-journal
     # and friends flip it on). See docs/RESILIENCE.md.

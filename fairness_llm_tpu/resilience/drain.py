@@ -48,6 +48,35 @@ logger = logging.getLogger(__name__)
 
 JOURNAL_FILENAME = "journal.jsonl"
 
+# Journal record schema versions — the migration table. Every ``submitted``
+# record written today carries ``schema_version: JOURNAL_SCHEMA_VERSION``;
+# readers accept every PAST version by explicit defaulting and refuse
+# FUTURE versions with :class:`JournalSchemaError` (a newer writer's
+# records must not be silently misparsed by an older resume).
+#
+#   version  written by            migration on read
+#   -------  --------------------  ----------------------------------------
+#   1        pre-schema_version    no ``schema_version`` field. Subsumes
+#            journals (≤ PR 19)    the pre-QoS era: missing ``qos``
+#                                  defaults to "interactive" (the Request
+#                                  default those runs implicitly served
+#                                  as); missing ``group``/``attribute``/
+#                                  ``pair_id`` default to None.
+#   2        PR 20+                adds ``schema_version`` and the
+#                                  optional ``version`` field (the rollout
+#                                  version pin of the replica that
+#                                  accepted the request; absent on
+#                                  fleet-intake records not yet placed).
+#                                  ``resume_serving`` uses it to keep a
+#                                  resumed request's stream single-version.
+JOURNAL_SCHEMA_VERSION = 2
+
+
+class JournalSchemaError(RuntimeError):
+    """A journal record carries a schema_version newer than this reader
+    understands — refusing beats misparsing (the record may carry fields
+    whose absence of handling silently corrupts the resume)."""
+
 
 class ServingJournal:
     """Crash-safe intake ledger for one serving directory."""
@@ -77,13 +106,19 @@ class ServingJournal:
         self._f.flush()
         os.fsync(self._f.fileno())
 
-    def record_submitted(self, request) -> None:
+    def record_submitted(self, request, version: Optional[str] = None) -> None:
         """Ledger one accepted request. Wall-clock timestamped (monotonic
         clocks don't survive the process this journal exists to outlive);
-        the remaining deadline is recomputed from it at resume."""
+        the remaining deadline is recomputed from it at resume.
+        ``version`` is the accepting replica's rollout version (None at
+        fleet intake, before placement); a replica-level record for the
+        same id supersedes the intake record (newest submission per id
+        wins in ``unfinished``), so the pin lands in the ledger."""
         s = request.settings
         self._append({
             "kind": "submitted",
+            "schema_version": JOURNAL_SCHEMA_VERSION,
+            **({"version": version} if version is not None else {}),
             "id": request.id,
             "prompt": request.prompt,
             "row_seed": request.row_seed,
@@ -165,12 +200,24 @@ class ServingJournal:
 
     def unfinished(self) -> List[Dict]:
         """Submitted records with no terminal record, newest submission per
-        id, in first-submission order — the resume workload."""
+        id, in first-submission order — the resume workload. Raises
+        :class:`JournalSchemaError` on a record from a FUTURE schema
+        version (see the migration table at ``JOURNAL_SCHEMA_VERSION``);
+        records without the field parse as version 1 (legacy
+        defaulting)."""
         submitted: Dict[str, Dict] = {}
         order: List[str] = []
         done = set()
         for rec in self.records():
             rid = rec.get("id")
+            sv = rec.get("schema_version", 1)
+            if not isinstance(sv, int) or sv > JOURNAL_SCHEMA_VERSION:
+                raise JournalSchemaError(
+                    f"journal record for id={rid!r} in {self.path} has "
+                    f"schema_version {sv!r}; this reader understands "
+                    f"<= {JOURNAL_SCHEMA_VERSION} — refusing to misparse "
+                    "a newer writer's journal (upgrade before resuming)"
+                )
             if rec.get("kind") == "submitted" and rid is not None:
                 if rid not in submitted:
                     order.append(rid)
@@ -306,6 +353,7 @@ def resume_serving(
     serving=None,
     resilience=None,
     fault_injector=None,
+    version: Optional[str] = None,
 ) -> Dict[str, object]:
     """Serve a journal's unfinished requests to termination; returns
     ``{request_id: Result}``.
@@ -315,10 +363,36 @@ def resume_serving(
     SAME journal so completions append terminal records and a drain during
     the resume journals survivors for the next attempt. Requests whose
     settings carry no sampler fields group under the scheduler default.
+
+    ``version`` is the resuming engine's rollout version. A record pinned
+    to a DIFFERENT version (the process died mid-rollout with v+1 work in
+    flight) is re-decoded from scratch on THIS engine and its pin
+    restamped — the wave is effectively rolled back at resume, each
+    request's final token stream stays single-version, and the restamps
+    are counted (``rollout_resume_restamped_total``) and logged so the
+    decision is auditable. Raises :class:`JournalSchemaError` on a
+    future-schema journal instead of misparsing it.
     """
     from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
 
-    requests = journal.to_requests()
+    specs = journal.unfinished()
+    requests = journal.to_requests(specs)
+    restamped = sorted(
+        s["id"] for s in specs
+        if s.get("version") is not None and s.get("version") != version
+    )
+    if restamped:
+        get_registry().counter(
+            "rollout_resume_restamped_total", component="rollout",
+        ).inc(len(restamped))
+        emit_event("rollout_resume_restamped", count=len(restamped),
+                   to_version=version, ids=restamped[:16])
+        logger.warning(
+            "resume-serving: %d request(s) were pinned to another rollout "
+            "version; re-decoding from scratch on this engine (version "
+            "%s) — the interrupted wave is rolled back at resume",
+            len(restamped), version,
+        )
     emit_event("resume_serving", unfinished=len(requests))
     logger.info("resume-serving: %d unfinished request(s) in %s",
                 len(requests), journal.path)
@@ -337,6 +411,9 @@ def resume_serving(
             fault_injector=fault_injector, resilience=resilience,
             journal=journal,
         )
+        # Re-journal under THIS engine's version: the resumed decode is
+        # the stream of record now, restamped pins included.
+        sched.journal_version = version
         for req, res in zip(reqs, sched.serve(reqs)):
             results[req.id] = res
     return results

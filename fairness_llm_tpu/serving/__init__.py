@@ -39,6 +39,10 @@ from fairness_llm_tpu.serving.paged import (
     init_arena,
 )
 from fairness_llm_tpu.serving.queue import AdmissionQueue, ClassedAdmissionQueue
+from fairness_llm_tpu.serving.rollout import (
+    RolloutController,
+    render_rollout_report,
+)
 from fairness_llm_tpu.serving.request import QOS_CLASSES, Request, Result
 from fairness_llm_tpu.serving.router import HealthRouter
 from fairness_llm_tpu.serving.scheduler import ContinuousScheduler
@@ -67,6 +71,8 @@ __all__ = [
     "HealthRouter",
     "Replica",
     "ReplicaSet",
+    "RolloutController",
+    "render_rollout_report",
     "Request",
     "Result",
     "ServingBackend",

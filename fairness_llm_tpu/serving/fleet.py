@@ -137,10 +137,15 @@ class Replica:
     watchdog), fence state, and the fleet's bookkeeping of what is
     currently routed to it."""
 
-    def __init__(self, name: str, engine, sched: ContinuousScheduler):
+    def __init__(self, name: str, engine, sched: ContinuousScheduler,
+                 version: str = "v0"):
         self.name = name
         self.engine = engine
         self.sched = sched
+        # Immutable engine/config version id (serving/rollout.py): which
+        # rollout generation this replica serves. Requests pin to the
+        # version that admitted them, so greedy parity holds per version.
+        self.version = version
         self.stats = ServingStats(num_slots=sched.num_slots)
         self.fenced = False
         self.fenced_at: Optional[float] = None
@@ -227,6 +232,17 @@ class ReplicaSet:
         # fleet that scaled 1 -> 2 -> 1 -> 2 reads r0/r1/r2 in telemetry
         # instead of two different lifetimes aliasing one "r1" label.
         self._replica_seq = self.fleet.replicas
+        # Version axis (serving/rollout.py): the fleet's CURRENT stable
+        # version; every replica carries the version it was built at, and
+        # every request pins to the version that admits it (migration
+        # stays same-version while that version has a live replica, so
+        # greedy token parity survives a mid-rollout fence).
+        self.version = "v0"
+        self._request_version: Dict[str, str] = {}
+        # The attached RolloutController, when a rollout is in flight
+        # (drives its wave machine from _tick; pauses the autoscaler —
+        # exactly one owner of replica membership at a time).
+        self.rollout = None
         self.replicas: List[Replica] = []
         for i, eng in enumerate(per_replica):
             rep_name = f"{name}.r{i}" if name else f"r{i}"
@@ -235,7 +251,10 @@ class ReplicaSet:
                 fault_injector=fault_injector, resilience=resilience,
                 journal=journal, replica=rep_name,
             )
-            self.replicas.append(Replica(rep_name, eng, sched))
+            sched.journal_version = self.version
+            self.replicas.append(
+                Replica(rep_name, eng, sched, version=self.version)
+            )
         # Stats of replicas retired mid-run (scale-down): folded into the
         # next _finish_stats so their completed/shed/token counts are not
         # lost from the fleet record with the replica.
@@ -283,7 +302,11 @@ class ReplicaSet:
         self._recovered_ids: set = set()
         self._canary_rr = 0  # periodic-canary round-robin cursor
         self._rejected_taken = 0
-        self._canary_ref = None  # shared rejoin-canary reference (lazy)
+        # Rejoin-canary references, one per VERSION (lazy): replicas of a
+        # version share one static-engine reference — a v+1 standby must
+        # be judged against v+1's own golden decode, not v's (every new
+        # version would fail a cross-version canary by construction).
+        self._canary_refs: Dict[str, object] = {}
         self._probe_seq = 0
         self._fence_t: Optional[float] = None
         self._failover_pending = False
@@ -549,6 +572,15 @@ class ReplicaSet:
         return bool(self._pending or len(self.queue) or self._migrating
                     or any(r.sched.has_work for r in self.replicas))
 
+    def request_version(self, request_id: str) -> Optional[str]:
+        """The version ``request_id`` is pinned to (the version whose
+        replica first admitted it — the engine its final token stream
+        belongs to), or None before placement. Pins are kept for the run
+        (a small per-request entry, like the journal's intake ledger) so
+        drills can assert per-version token parity after Results are
+        claimed."""
+        return self._request_version.get(request_id)
+
     def drain(self) -> ServingStats:
         """Run the fleet loop until nothing is owed, then close out the
         stats window — the streaming companion to ``serve()``. Terminated
@@ -575,11 +607,22 @@ class ReplicaSet:
             )
             self.shed_controller.maybe_evaluate()
         progressed = False
-        if self.autoscaler is not None:
+        rollout_active = self.rollout is not None and self.rollout.active
+        if self.autoscaler is not None and not rollout_active:
             # Membership control BEFORE routing: a replica added this tick
             # takes traffic this tick, and a retired one has already
             # migrated its work into _migrating for _route to place.
+            # During an active rollout the autoscaler is PAUSED — the
+            # RolloutController owns replica membership for the wave's
+            # duration (two controllers adding/retiring replicas against
+            # each other would thrash the canary gate), and it notes the
+            # arbitration in rollout_autoscale_paused_total.
             progressed |= self.autoscaler.maybe_tick()
+        if rollout_active:
+            # Same placement as the autoscaler: a v+1 standby added this
+            # tick takes traffic this tick, and a rollback's evacuated
+            # work is already in _migrating for _route to place.
+            progressed |= self.rollout.maybe_tick()
         progressed |= self._expire_held()
         progressed |= self._route()
         # list(): the autoscaler (above) is not the only mutation source —
@@ -677,7 +720,37 @@ class ReplicaSet:
             self._pending = kept
         while self._migrating:
             req = self._migrating[0]
-            rep = self.router.pick(self.replicas, qos=req.qos)
+            # Pinned-version affinity (serving/rollout.py): a migrated
+            # request lands ONLY on a replica of the version that admitted
+            # it — cross-version migration would splice two engines' token
+            # streams and break greedy parity. While the pinned version
+            # has a live unfenced replica, an unroutable pick HOLDS (the
+            # bounded-queue backpressure stance); only when the version
+            # has no live replica at all (rollback retired it, or its
+            # last replica fenced) is the pin restamped — the request
+            # re-decodes from scratch on the surviving version, so its
+            # final stream is still single-version.
+            pinned = self._request_version.get(req.id)
+            rep = self.router.pick(self.replicas, qos=req.qos,
+                                   require_version=pinned)
+            if rep is None and pinned is not None and not any(
+                r.version == pinned and not r.fenced for r in self.replicas
+            ):
+                rep = self.router.pick(self.replicas, qos=req.qos)
+                if rep is not None:
+                    get_registry().counter(
+                        "rollout_affinity_restamped_total",
+                        component="rollout", **self._fleet_labels,
+                    ).inc()
+                    record_decision(
+                        "rollout", "restamp",
+                        signals={"from_version": pinned,
+                                 "to_version": rep.version},
+                        request_id=req.id, replica=rep.name,
+                    )
+                    emit_event("rollout_affinity_restamped",
+                               request_id=req.id, from_version=pinned,
+                               to_version=rep.version)
             if rep is None:
                 break
             self._migrating.popleft()
@@ -693,6 +766,7 @@ class ReplicaSet:
                 self._migrating.appendleft(req)
                 break
             rep.assigned[req.id] = req
+            self._request_version[req.id] = rep.version
             moved = True
         while len(self.queue):
             req = self.queue.pop(1)[0]
@@ -708,6 +782,10 @@ class ReplicaSet:
                 self.queue.requeue(req)
                 break
             rep.assigned[req.id] = req
+            # Pin at FIRST placement: the request completes on this
+            # version (its first token is this engine's), and any later
+            # migration must stay on it.
+            self._request_version[req.id] = rep.version
             moved = True
         return moved
 
@@ -889,7 +967,9 @@ class ReplicaSet:
 
     # -- elastic membership (serving/autoscaler.py) --------------------------
 
-    def add_replica(self) -> Optional[Replica]:
+    def add_replica(self, engine=None, version: Optional[str] = None,
+                    serving: Optional[ServingConfig] = None
+                    ) -> Optional[Replica]:
         """Instantiate a STANDBY replica — its own scheduler, slot pool,
         breakers, and watchdog over the engine pool's params — and
         canary-gate it through the fleet's rejoin probe BEFORE it joins:
@@ -898,17 +978,26 @@ class ReplicaSet:
         joined Replica, or None when the probe refused it (counted in
         ``fleet_standby_denied_total``; the autoscaler retries after its
         cooldown). Names are monotone (``r<seq>``) so a scaled-away
-        replica's telemetry is never aliased by a later arrival."""
+        replica's telemetry is never aliased by a later arrival.
+
+        ``engine``/``version``/``serving`` (serving/rollout.py): a rollout
+        adds its v+1 standby here with the NEW engine/config and version
+        id — the canary gate then judges it against its own version's
+        golden reference. Defaults (the autoscaler path) draw from the
+        engine pool at the fleet's current version."""
         i = self._replica_seq
         self._replica_seq += 1
         rep_name = f"{self.name}.r{i}" if self.name else f"r{i}"
-        engine = self._engine_pool[i % len(self._engine_pool)]
+        if engine is None:
+            engine = self._engine_pool[i % len(self._engine_pool)]
+        version = version or self.version
         sched = ContinuousScheduler(
-            engine, self._rep_serving, settings=self.settings,
+            engine, serving or self._rep_serving, settings=self.settings,
             fault_injector=self.fault_injector, resilience=self.resilience,
             journal=self.journal, replica=rep_name,
         )
-        rep = Replica(rep_name, engine, sched)
+        sched.journal_version = version
+        rep = Replica(rep_name, engine, sched, version=version)
         if not self._rejoin_probe(rep):
             get_registry().counter(
                 "fleet_standby_denied_total", component="fleet",
@@ -1074,18 +1163,22 @@ class ReplicaSet:
         if rep.canary is None:
             from fairness_llm_tpu.integrity.canary import CanaryProbe
 
-            if self._canary_ref is None:
+            ref = self._canary_refs.get(rep.version)
+            if ref is None:
                 # Clamped to the serving decode cap: the probe decodes
                 # through the replica's scheduler, which clamps every
                 # request to max_new_tokens — a reference recorded longer
                 # than the scheduler can decode would fail the
                 # pads-beyond-prefix check on a perfectly healthy replica.
-                self._canary_ref = CanaryProbe.record(
+                # Keyed by VERSION: a rollout's v+1 standby is compared
+                # against its own engine's golden decode.
+                ref = CanaryProbe.record(
                     rep.engine,
                     max_tokens=min(self.integrity.canary_max_tokens,
                                    self.serving.max_new_tokens),
                 )
-            rep.canary = self._canary_ref.for_replica(
+                self._canary_refs[rep.version] = ref
+            rep.canary = ref.for_replica(
                 rep.name, board=rep.sched.breakers
             )
         return rep.canary

@@ -437,7 +437,14 @@ class ReplayDriver:
             trace_span_s=self.events[-1].t if self.events else 0.0,
         )
         outstanding: Dict[str, TraceEvent] = {}
-        retry: List[TraceEvent] = []  # backpressured, arrival stays due
+        # Backpressured/shed arrivals awaiting re-offer, as (event,
+        # not-before trace-time). Backpressure keeps the hot re-offer
+        # (not_before = now); a SHED Result's retry_after_s is honored as
+        # real wall seconds (x compression = trace seconds), so the replay
+        # client backs off exactly as a well-behaved caller would instead
+        # of hammering the gate every poll.
+        retry: List[Tuple[TraceEvent, float]] = []
+        shed_retried: Dict[str, int] = {}  # id -> honored-shed count
         i = 0
         t0_wall = time.monotonic()
         reg.counter("replay_events_total", component="replay") \
@@ -450,8 +457,9 @@ class ReplayDriver:
             if submitting:
                 due: List[Tuple[TraceEvent, bool]] = []
                 if retry:
-                    due.extend((ev, True) for ev in retry)
-                    retry = []
+                    still_held = [(ev, nb) for ev, nb in retry if nb > now]
+                    due.extend((ev, True) for ev, nb in retry if nb <= now)
+                    retry = still_held
                 while i < len(self.events) and self.events[i].t <= now:
                     due.append((self.events[i], False))
                     i += 1
@@ -470,8 +478,26 @@ class ReplayDriver:
                         continue
                     res = self.fleet.take_result(ev.id)
                     if res is not None:
+                        if res.retry_after_s is not None and \
+                                shed_retried.get(ev.id, 0) < 1:
+                            # The gate shed WITH retry advice: honor it
+                            # once. No outcome is recorded — the arrival
+                            # comes back after the advised backoff and
+                            # its retry decides terminal-vs-shed. Trace
+                            # time runs compression x wall, so wall
+                            # advice maps to advice x compression.
+                            shed_retried[ev.id] = \
+                                shed_retried.get(ev.id, 0) + 1
+                            reg.counter("replay_retry_after_honored_total",
+                                        component="replay").inc()
+                            retry.append(
+                                (ev, now + res.retry_after_s
+                                 * self.compression))
+                            progressed = True
+                            continue
                         # Terminal shed at the gate — an explicit refusal
-                        # Result, not backpressure.
+                        # Result, not backpressure (or retry advice was
+                        # already honored once: record the re-shed).
                         report.gate_sheds += 1
                         self._record(report, ev, res, reg, accepted=False)
                         progressed = True
@@ -479,7 +505,7 @@ class ReplayDriver:
                         report.backpressured += 1
                         reg.counter("replay_backpressure_total",
                                     component="replay").inc()
-                        retry.append(ev)
+                        retry.append((ev, now))
             progressed |= self.fleet.tick()
             for rid in list(outstanding):
                 res = self.fleet.take_result(rid)

@@ -76,6 +76,40 @@ class HealthRouter:
 
     def __init__(self, fleet: Optional[FleetConfig] = None):
         self.fleet = fleet or FleetConfig()
+        # Rollout traffic split (serving/rollout.py): (version, fraction)
+        # steers that share of fresh admissions onto replicas of
+        # ``version`` via error-diffusion (deterministic — the exact
+        # fraction over any window, no RNG). None = version-blind.
+        self._version_traffic: Optional[tuple] = None
+        self._traffic_acc = 0.0
+
+    # -- rollout traffic split (serving/rollout.py) --------------------------
+
+    def set_version_traffic(self, version: Optional[str],
+                            fraction: float = 0.0) -> None:
+        """Steer ``fraction`` of fresh admissions to replicas at
+        ``version`` (the RolloutController's traffic-shift lever).
+        ``None``/0 clears the split. Pinned-version migrations
+        (``require_version``) bypass the split entirely."""
+        if version is None or fraction <= 0.0:
+            self._version_traffic = None
+        else:
+            self._version_traffic = (version, min(1.0, float(fraction)))
+        self._traffic_acc = 0.0
+
+    def _steer(self) -> Optional[tuple]:
+        """``(version, to_new)`` for this admission under the active
+        split — ``to_new`` True steers ONTO ``version``, False away from
+        it (error diffusion: accumulate the fraction, emit the new
+        version each time the accumulator crosses 1). None = no split."""
+        if self._version_traffic is None:
+            return None
+        version, frac = self._version_traffic
+        self._traffic_acc += frac
+        if self._traffic_acc >= 1.0:
+            self._traffic_acc -= 1.0
+            return (version, True)
+        return (version, False)
 
     # -- scoring -------------------------------------------------------------
 
@@ -148,7 +182,8 @@ class HealthRouter:
         return self.health_score(replica) / (1.0 + self.load(replica))
 
     def pick(self, replicas: Sequence,
-             qos: Optional[str] = None) -> Optional[object]:
+             qos: Optional[str] = None,
+             require_version: Optional[str] = None) -> Optional[object]:
         """The target for ONE admission: the routable replica (not fenced,
         queue open and not full, nonzero health) with the highest
         placement weight; ties break on name. None when nothing is
@@ -161,7 +196,40 @@ class HealthRouter:
         so recovery headroom isn't spent on deferrable work. A soft
         preference only: when every routable replica is burning, placement
         falls back to the plain weighting (holding batch until burn
-        gauges decay would stall whole-batch workloads on a transient)."""
+        gauges decay would stall whole-batch workloads on a transient).
+
+        ``require_version`` (serving/rollout.py): HARD filter to replicas
+        at that version — pinned-version migration affinity; None from a
+        version-filtered pick means *hold*, never cross versions (the
+        fleet decides when a pin is unservable and restamps). Without it,
+        an active traffic split (``set_version_traffic``) SOFT-steers this
+        admission on/off the new version, falling back to version-blind
+        placement when the steered side has nothing routable."""
+        if require_version is not None:
+            replicas = [
+                r for r in replicas
+                if getattr(r, "version", require_version) == require_version
+            ]
+        else:
+            steer = self._steer()
+            if steer is not None:
+                version, to_new = steer
+                side = [
+                    r for r in replicas
+                    if (getattr(r, "version", None) == version) == to_new
+                ]
+                chosen = self._pick_among(side, qos)
+                if chosen is not None:
+                    return self._record_pick(*chosen, qos=qos)
+        chosen = self._pick_among(replicas, qos)
+        if chosen is None:
+            return None
+        return self._record_pick(*chosen, qos=qos)
+
+    def _pick_among(self, replicas: Sequence,
+                    qos: Optional[str]) -> Optional[tuple]:
+        """Best routable replica among ``replicas`` (see ``pick``):
+        ``(replica, weight, calm_preferred)``, or None."""
         best, best_weight = None, 0.0
         calm_best, calm_weight = None, 0.0
         prefer_calm = qos is not None and qos != "interactive"
@@ -180,23 +248,26 @@ class HealthRouter:
                     weight == calm_weight and rep.name < calm_best.name
                 ):
                     calm_best, calm_weight = rep, weight
-        chosen = calm_best if (prefer_calm and calm_best is not None) else best
-        if chosen is not None:
-            # Decision audit trail (telemetry/incidents.py): which replica
-            # took this admission and at what weight — ring-complete,
-            # JSONL-throttled (placement is the hottest decision point).
-            record_decision(
-                "route", chosen.name,
-                signals={
-                    "weight": round(
-                        calm_weight if chosen is calm_best else best_weight,
-                        4),
-                    "qos": qos or "-",
-                    "calm_preferred": bool(prefer_calm
-                                           and calm_best is not None),
-                },
-                replica=chosen.name,
-            )
+        if prefer_calm and calm_best is not None:
+            return (calm_best, calm_weight, True)
+        if best is None:
+            return None
+        return (best, best_weight, False)
+
+    def _record_pick(self, chosen, weight: float, calm: bool,
+                     qos: Optional[str]) -> object:
+        # Decision audit trail (telemetry/incidents.py): which replica
+        # took this admission and at what weight — ring-complete,
+        # JSONL-throttled (placement is the hottest decision point).
+        record_decision(
+            "route", chosen.name,
+            signals={
+                "weight": round(weight, 4),
+                "qos": qos or "-",
+                "calm_preferred": calm,
+            },
+            replica=chosen.name,
+        )
         return chosen
 
     @staticmethod

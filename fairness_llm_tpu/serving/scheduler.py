@@ -382,6 +382,11 @@ class ContinuousScheduler:
             if r.enabled and r.max_step_seconds > 0 else None
         )
         self.journal = journal
+        # Version id stamped on this scheduler's journal records (set by
+        # the fleet to its replica's rollout version; None outside a
+        # fleet): resume-serving reads it to keep a resumed request's
+        # token stream single-version (serving/rollout.py).
+        self.journal_version: Optional[str] = None
         self._drain_flag = False
         # Per-drain grace override (request_drain(grace_s=...)): the fleet
         # fences with grace 0 — a sick replica must not keep decoding work
@@ -683,7 +688,8 @@ class ContinuousScheduler:
                 # Ledger at ACCEPTANCE (not admission): from here on the
                 # request must reach a terminal Result or survive in the
                 # journal — the zero-lost contract a preemption is judged on.
-                self.journal.record_submitted(request)
+                self.journal.record_submitted(request,
+                                              version=self.journal_version)
         return accepted
 
     def take_result(self, request_id: str) -> Optional[Result]:
@@ -728,7 +734,7 @@ class ContinuousScheduler:
             r.submitted_at = now
             self.tracer.record(r.id, "submitted", t=now)
             if self.journal is not None:
-                self.journal.record_submitted(r)
+                self.journal.record_submitted(r, version=self.journal_version)
         self._pending = deque(requests)
         self._run_loop(stats)
         self.last_stats = stats

@@ -89,6 +89,7 @@ DECISIONS = (
     "canary",      # canary probe verdict (ok / mismatch)
     "slo_alert",   # burn-rate alert crossing
     "heartbeat",   # missed-beat gap classified
+    "rollout",     # RolloutController wave transition / gate verdict
     "incident",    # a trigger fired (dumped or suppressed)
 )
 
@@ -105,6 +106,7 @@ INCIDENT_CLASSES = (
     "integrity_fault",
     "heartbeat_gap",
     "memory_pressure",   # paged-arena exhaustion deferred admissions
+    "rollout",           # a rollout rolled back (the gate that fired)
 )
 
 # Per-decision-kind JSONL emission throttle: the ring keeps the complete

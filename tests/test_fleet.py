@@ -507,14 +507,15 @@ def test_fleet_backend_periodic_canary_contains_mismatch(engine):
                         replica="r0") == 1
         assert _counter(reg, "canary_mismatch_total", component="serving",
                         replica="r0") == 0
-        # Silent corruption, as the comparator sees it: the shared
-        # reference is tampered (copy — the recorded array is read-only),
-        # so the NEXT probe (round-robin: r1, whose per-replica canary is
-        # built from the shared ref on first use) mismatches and must
-        # trip r1's own decode breaker.
-        tampered = fleet._canary_ref.reference.copy()
+        # Silent corruption, as the comparator sees it: the fleet
+        # version's shared reference is tampered (copy — the recorded
+        # array is read-only), so the NEXT probe (round-robin: r1, whose
+        # per-replica canary is built from the shared ref on first use)
+        # mismatches and must trip r1's own decode breaker.
+        ref = fleet._canary_refs[fleet.version]
+        tampered = ref.reference.copy()
         tampered[0] += 1
-        fleet._canary_ref.reference = tampered
+        ref.reference = tampered
         texts = backend.generate(PROMPTS[2:4], greedy(8), seed=0)
         assert all(t is not None for t in texts)  # traffic kept flowing
         assert _counter(reg, "canary_mismatch_total", component="serving",

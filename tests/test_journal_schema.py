@@ -1,0 +1,147 @@
+"""Journal schema versioning regression tests (PR 20, satellite 2).
+
+The serving journal is the one artifact that crosses process — and now
+software-version — boundaries: a v+1 writer's journal may be read by a
+v reader after a rollback. Every record written today carries
+``schema_version``; the reader's contract is a three-way split:
+
+* **no field** — legacy v1 record, parsed with v1 defaulting (every
+  pre-versioning journal keeps resuming forever);
+* **known version** (<= ``JOURNAL_SCHEMA_VERSION``) — parsed via the
+  migration table;
+* **future version** — refused with :class:`JournalSchemaError`, a
+  *named* error, instead of a silent misparse that would resume requests
+  with wrong deadlines/pins. Rollback keeps the newer journal intact; the
+  operator upgrades before resuming.
+"""
+
+import json
+
+import pytest
+
+from fairness_llm_tpu.config import ModelSettings
+from fairness_llm_tpu.resilience import ServingJournal, resume_serving
+from fairness_llm_tpu.resilience.drain import (
+    JOURNAL_SCHEMA_VERSION,
+    JournalSchemaError,
+)
+from fairness_llm_tpu.serving import Request
+from fairness_llm_tpu.telemetry import use_registry
+
+GREEDY = ModelSettings(temperature=0.0, max_tokens=8)
+
+
+def _req(i):
+    return Request(prompt=f"prompt {i}", id=f"s{i}", settings=GREEDY,
+                   row_seed=100 + i)
+
+
+def _strip_schema_fields(path):
+    """Rewrite a journal as a legacy (pre-versioning) writer would have."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            rec.pop("schema_version", None)
+            rec.pop("version", None)
+            out.append(rec)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in out:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def _bump_schema(path, to):
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("kind") == "submitted":
+                rec["schema_version"] = to
+            out.append(rec)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in out:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def test_current_writer_stamps_schema_version(tmp_path):
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(_req(0))
+    j.record_submitted(_req(1), version="v3")
+    (r0, r1) = j.unfinished()
+    assert r0["schema_version"] == JOURNAL_SCHEMA_VERSION
+    assert "version" not in r0  # intake record: no pin yet
+    assert r1["schema_version"] == JOURNAL_SCHEMA_VERSION
+    assert r1["version"] == "v3"  # the rollout pin survives the ledger
+
+
+def test_versionless_journal_parses_as_v1(tmp_path):
+    # A journal written before schema versioning existed must keep
+    # resuming: records without the field default to version 1.
+    j = ServingJournal(str(tmp_path))
+    for i in range(3):
+        j.record_submitted(_req(i))
+    j.record_terminal("s1", "completed")
+    j.close()
+    _strip_schema_fields(j.path)
+
+    j2 = ServingJournal(str(tmp_path))
+    specs = j2.unfinished()
+    assert [r["id"] for r in specs] == ["s0", "s2"]
+    assert all("schema_version" not in r for r in specs)
+    reqs = j2.to_requests(specs)
+    assert [r.id for r in reqs] == ["s0", "s2"]
+    assert reqs[0].settings == GREEDY
+
+
+def test_future_schema_version_refused_by_name(tmp_path):
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(_req(0))
+    j.close()
+    _bump_schema(j.path, JOURNAL_SCHEMA_VERSION + 1)
+
+    j2 = ServingJournal(str(tmp_path))
+    with pytest.raises(JournalSchemaError) as exc:
+        j2.unfinished()
+    msg = str(exc.value)
+    assert str(JOURNAL_SCHEMA_VERSION + 1) in msg  # names the version seen
+    assert str(JOURNAL_SCHEMA_VERSION) in msg      # and what we understand
+    assert "s0" in msg                             # and the offending record
+
+
+def test_garbled_schema_version_refused_not_misparsed(tmp_path):
+    # A non-int schema_version is a corrupt or hostile record, not a
+    # legacy one — refuse, don't default.
+    j = ServingJournal(str(tmp_path))
+    j.record_submitted(_req(0))
+    j.close()
+    _bump_schema(j.path, "two")
+
+    with pytest.raises(JournalSchemaError):
+        ServingJournal(str(tmp_path)).unfinished()
+
+
+def test_resume_serving_refuses_future_journal(tmp_path):
+    # The refusal must surface through the real resume entry point — the
+    # process-boundary API a post-rollback operator actually calls.
+    with use_registry():
+        j = ServingJournal(str(tmp_path))
+        j.record_submitted(_req(0))
+        j.close()
+        _bump_schema(j.path, JOURNAL_SCHEMA_VERSION + 5)
+        with pytest.raises(JournalSchemaError):
+            resume_serving(None, ServingJournal(str(tmp_path)))
+
+
+def test_rotation_preserves_schema_version(tmp_path):
+    # Compaction rewrites records verbatim: the stamped version (and the
+    # rollout pin) must ride through a rotate, or an old journal would be
+    # silently "upgraded" by housekeeping.
+    with use_registry():
+        j = ServingJournal(str(tmp_path), rotate_every=1)
+        j.record_submitted(_req(0), version="v2")
+        j.record_submitted(_req(1))
+        j.record_terminal("s1", "completed")  # triggers compaction
+        (rec,) = j.records()
+        assert rec["id"] == "s0"
+        assert rec["schema_version"] == JOURNAL_SCHEMA_VERSION
+        assert rec["version"] == "v2"

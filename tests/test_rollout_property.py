@@ -1,0 +1,380 @@
+"""Property-based RolloutController invariants (hypothesis over random
+fault/gate sequences), sibling of test_breaker_property.py.
+
+The rollout wave machine owns fleet membership during an upgrade, so its
+state machine is load-bearing for every serving guarantee at once:
+
+1. **Transition order**: the controller only ever moves along
+   ``LEGAL_TRANSITIONS`` — whatever faults, gate signals, and clock jumps
+   land in whatever order. Terminal states are absorbing: once
+   ``rolled_back`` or ``complete``, further ticks are no-ops.
+2. **Rollback reachability**: from EVERY non-terminal started state there
+   is a fault/gate sequence that lands in ``rolled_back`` — no wave
+   position exists where the operator has lost the abort lever.
+3. **Version affinity**: the router's hard version filter
+   (``pick(require_version=...)``) never returns a replica of another
+   version — under any mix of versions, fences, and load, a pinned
+   request either stays on its version or waits (the fleet restamps only
+   when the pinned version has no live replica at all).
+"""
+
+import pytest
+
+try:  # the fuzzed tests gate on hypothesis; deterministic ones always run
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover
+    given = settings = st = None
+
+from fairness_llm_tpu.config import FleetConfig, RolloutConfig
+from fairness_llm_tpu.integrity.manifest import IntegrityError
+from fairness_llm_tpu.serving import HealthRouter
+from fairness_llm_tpu.serving.rollout import (
+    LEGAL_TRANSITIONS,
+    ROLLOUT_STATES,
+    TERMINAL_STATES,
+    RolloutController,
+)
+from fairness_llm_tpu.telemetry import use_registry
+
+WINDOW_S = 1.0
+
+
+# -- the duck-typed fleet the controller drives -------------------------------
+
+
+class FakeReplica:
+    def __init__(self, name, version):
+        self.name = name
+        self.version = version
+        self.fenced = False
+        self.fence_reason = None
+        self.sched = type("S", (), {"breakers": None})()
+
+
+class FakeRouter:
+    def __init__(self):
+        self.traffic = None
+
+    def set_version_traffic(self, version, fraction=0.0):
+        self.traffic = None if version is None or fraction <= 0.0 \
+            else (version, fraction)
+
+    def load(self, rep):
+        return 0.0
+
+
+class FakeFleet:
+    """The exact surface RolloutController touches on ReplicaSet."""
+
+    def __init__(self, n=2, version="v0"):
+        self.version = version
+        self.replicas = [FakeReplica(f"r{i}", version) for i in range(n)]
+        self.router = FakeRouter()
+        self.name = None
+        self.autoscaler = None
+        self.rollout = None
+        self.refuse_add = False
+        self._seq = n
+        self._engine_pool = [object()]
+        self._rep_serving = None
+
+    def add_replica(self, engine=None, version=None, serving=None):
+        if self.refuse_add:
+            return None  # the standby's canary gate said no
+        rep = FakeReplica(f"r{self._seq}", version or self.version)
+        self._seq += 1
+        self.replicas.append(rep)
+        return rep
+
+    def retire_replica(self, rep):
+        assert len(self.replicas) > 1, "retire would empty the fleet"
+        self.replicas.remove(rep)
+        return 0
+
+    def _fence(self, rep, reason):
+        rep.fenced = True
+        rep.fence_reason = reason
+
+
+def build(n=2, **cfg):
+    fleet = FakeFleet(n=n)
+    clock = {"t": 0.0}
+    ro = RolloutController(
+        fleet, "v1", engine=object(),
+        config=RolloutConfig(enabled=True, canary_window_s=WINDOW_S,
+                             traffic_steps=2, **cfg),
+        clock=lambda: clock["t"],
+    )
+    return fleet, clock, ro
+
+
+def spy_transitions(ro):
+    seen = []
+    orig = ro._transition
+
+    def spy(to, now, cause=None):
+        seen.append((ro.state, to))
+        orig(to, now=now, cause=cause)
+
+    ro._transition = spy
+    return seen
+
+
+# -- 1 + 2: transition order and rollback reachability, fuzzed ---------------
+
+OP_NAMES = [
+    "tick",         # one controller step, clock unchanged
+    "window",       # the gate window elapses, then a step
+    "fence_new",    # watchdog/breaker verdict on a new-version replica
+    "canary_fail",  # canary mismatch published for a new replica
+    "refuse_add",   # the NEXT standby fails its join canary
+    "allow_add",
+]
+
+
+def _run_fault_sequence(ops, n):
+    with use_registry():
+        from fairness_llm_tpu.telemetry import get_registry
+
+        fleet, clock, ro = build(n=n)
+        seen = spy_transitions(ro)
+        ro.start()
+        for op in ops:
+            if ro.state in TERMINAL_STATES:
+                break
+            if op == "tick":
+                ro.tick()
+            elif op == "window":
+                clock["t"] += WINDOW_S + 0.01
+                ro.tick()
+            elif op == "fence_new":
+                for rep in ro.new_replicas:
+                    fleet._fence(rep, "replica_crash")
+            elif op == "canary_fail":
+                for rep in ro.new_replicas:
+                    get_registry().gauge(
+                        "canary_last_ok", component="serving",
+                        replica=rep.name,
+                    ).set(0.0)
+            elif op == "refuse_add":
+                fleet.refuse_add = True
+            else:
+                fleet.refuse_add = False
+            clock["t"] += 0.01
+
+        assert all(edge in LEGAL_TRANSITIONS for edge in seen), seen
+        assert ro.state in ROLLOUT_STATES
+
+        # Terminal states are absorbing.
+        if ro.state in TERMINAL_STATES:
+            before = ro.state
+            assert ro.tick() is False
+            assert ro.state == before
+
+        # Rollback (or legitimate completion) is reachable from ANY
+        # random prefix: fencing every new replica and ticking must land
+        # terminal — the abort lever never goes dead mid-wave.
+        forced = False
+        for _ in range(8 * n + 16):
+            if ro.state in TERMINAL_STATES:
+                break
+            if ro.new_replicas:
+                forced = True
+                for rep in ro.new_replicas:
+                    fleet._fence(rep, "replica_crash")
+            clock["t"] += WINDOW_S + 0.01
+            ro.tick()
+        assert ro.state in TERMINAL_STATES, ro.state
+        if forced and ro.state == "rolled_back":
+            assert ro.cause is not None
+        assert all(edge in LEGAL_TRANSITIONS for edge in seen), seen
+        # However it ended, the fleet is never left version-mixed or
+        # fenced: survivors are whole.
+        live = [r for r in fleet.replicas if not r.fenced]
+        assert live and len({r.version for r in live}) == 1
+
+
+if st is not None:
+
+    @settings(max_examples=100, deadline=None)
+    @given(
+        ops=st.lists(st.sampled_from(OP_NAMES), max_size=60),
+        n=st.integers(min_value=2, max_value=4),
+    )
+    def test_rollout_legal_transitions_under_random_faults(ops, n):
+        _run_fault_sequence(ops, n)
+
+
+def test_rollout_legal_transitions_fixed_sequences():
+    # Deterministic corpus so the invariants hold even where hypothesis
+    # isn't installed: clean completion, every gate, and absorbing ends.
+    corpus = [
+        ["window"] * 12,                               # clean v0 -> v1
+        ["tick", "tick", "fence_new", "window"] * 4,   # watchdog gate
+        ["tick", "tick", "canary_fail", "tick"] * 4,   # canary mismatch
+        ["refuse_add", "tick", "tick", "tick"],        # standby refused
+        ["tick", "window", "allow_add", "fence_new", "window"] * 3,
+        [],                                            # started, untouched
+    ]
+    for ops in corpus:
+        for n in (2, 3):
+            _run_fault_sequence(ops, n)
+
+
+def test_rollback_reachable_from_every_nonterminal_state():
+    # preparing: the manifest gate (engine_fn refused).
+    with use_registry():
+        fleet = FakeFleet()
+        clock = {"t": 0.0}
+
+        def refused():
+            raise IntegrityError("digest mismatch: model.safetensors")
+
+        ro = RolloutController(fleet, "v1", engine_fn=refused,
+                               config=RolloutConfig(enabled=True),
+                               clock=lambda: clock["t"])
+        ro.start()
+        assert ro.state == "preparing"
+        ro.tick()
+        assert ro.state == "rolled_back" and "manifest" in ro.cause
+
+    # canary: the standby's join canary refuses.
+    with use_registry():
+        fleet, clock, ro = build()
+        fleet.refuse_add = True
+        ro.start()
+        ro.tick()  # preparing -> canary
+        assert ro.state == "canary"
+        ro.tick()  # add refused -> rollback
+        assert ro.state == "rolled_back" and "canary" in ro.cause
+
+    # shifting: a watchdog fence on the new replica mid-window.
+    with use_registry():
+        fleet, clock, ro = build()
+        ro.start()
+        ro.tick()
+        ro.tick()
+        assert ro.state == "shifting"
+        for rep in ro.new_replicas:
+            fleet._fence(rep, "replica_crash")
+        ro.tick()
+        assert ro.state == "rolled_back" and "watchdog" in ro.cause
+
+    # retiring: gates stay armed through the wave tail.
+    with use_registry():
+        fleet, clock, ro = build()
+        ro.start()
+        ro.tick()
+        ro.tick()
+        while ro.state == "shifting":
+            clock["t"] += WINDOW_S + 0.01
+            ro.tick()
+        assert ro.state == "retiring"
+        for rep in ro.new_replicas:
+            fleet._fence(rep, "rollout_probe")
+        ro.tick()
+        assert ro.state == "rolled_back" and "breaker" in ro.cause
+
+    # crash resolution: terminal from any mid-wave state, no membership.
+    with use_registry():
+        fleet, clock, ro = build()
+        ro.start()
+        ro.tick()
+        ro.tick()
+        assert ro.state == "shifting"
+        ro.resolve_crashed("test crash")
+        assert ro.state == "rolled_back" and "crash" in ro.cause
+
+
+# -- 3: version affinity under the router's hard filter ----------------------
+
+
+class _StubQueue:
+    def __init__(self, depth=0, full=False):
+        self.depth, self.full, self.closed = depth, full, False
+
+    def __len__(self):
+        return self.depth
+
+
+class _StubSched:
+    def __init__(self, occupancy=0, depth=0, full=False):
+        self.pool = type("P", (), {"occupancy": occupancy})()
+        self.queue = _StubQueue(depth, full=full)
+        self._pending = []
+        self.breakers = None
+        self.watchdog = None
+        self.num_slots = 4
+
+
+class _StubReplica:
+    def __init__(self, name, version, fenced=False, occupancy=0, depth=0,
+                 full=False):
+        self.name = name
+        self.version = version
+        self.fenced = fenced
+        self.sched = _StubSched(occupancy=occupancy, depth=depth, full=full)
+
+
+def _check_affinity(rows, pinned, frac):
+    # rows: (version, fenced, occupancy, queue depth, queue full) tuples.
+    with use_registry():
+        router = HealthRouter(FleetConfig(replicas=max(2, len(rows))))
+        router.set_version_traffic("v1", frac)
+        replicas = [
+            _StubReplica(f"r{i}", v, fenced=f, occupancy=o, depth=d,
+                         full=fl)
+            for i, (v, f, o, d, fl) in enumerate(rows)
+        ]
+        placeable = [r for r in replicas
+                     if r.version == pinned and not r.fenced
+                     and not r.sched.queue.full]
+        for _ in range(4):  # the steering accumulator cycles; hold always
+            chosen = router.pick(replicas, require_version=pinned)
+            if chosen is not None:
+                # The hard filter: NEVER a cross-version placement.
+                assert chosen.version == pinned
+            else:
+                # Refusal is only legal when no placeable same-version
+                # replica exists — otherwise affinity would starve.
+                assert not placeable
+
+
+if st is not None:
+
+    @settings(max_examples=200, deadline=None)
+    @given(
+        rows=st.lists(
+            st.tuples(
+                st.sampled_from(["v0", "v1"]),
+                st.booleans(),
+                st.integers(min_value=0, max_value=4),
+                st.integers(min_value=0, max_value=8),
+                st.booleans(),
+            ),
+            min_size=1, max_size=6,
+        ),
+        pinned=st.sampled_from(["v0", "v1"]),
+        frac=st.floats(min_value=0.0, max_value=1.0),
+    )
+    def test_version_affinity_never_violated(rows, pinned, frac):
+        _check_affinity(rows, pinned, frac)
+
+
+def test_version_affinity_fixed_cases():
+    cases = [
+        # mixed versions, all placeable
+        ([("v0", False, 0, 0, False), ("v1", False, 0, 0, False)], "v0", 0.5),
+        ([("v0", False, 0, 0, False), ("v1", False, 0, 0, False)], "v1", 0.5),
+        # pinned version fenced out entirely -> pick must refuse
+        ([("v0", True, 0, 0, False), ("v1", False, 2, 1, False)], "v0", 1.0),
+        # pinned version only behind a full queue -> refuse, never cross
+        ([("v1", False, 4, 8, True), ("v0", False, 0, 0, False)], "v1", 0.0),
+        # single-version fleet, heavy load spread
+        ([("v0", False, i, i, False) for i in range(5)], "v0", 0.0),
+        # everything fenced
+        ([("v0", True, 0, 0, False), ("v1", True, 0, 0, False)], "v1", 1.0),
+    ]
+    for rows, pinned, frac in cases:
+        _check_affinity(rows, pinned, frac)

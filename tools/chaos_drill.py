@@ -421,13 +421,27 @@ def main() -> int:
     ov_serving = ServingConfig(enabled=True, num_slots=2, queue_capacity=12,
                                max_prompt_len=192, max_new_tokens=32,
                                decode_chunk=4)
+    # The drill's earlier sections leave UNLABELED serving gauges behind
+    # (notably a pegged slo_burn_rate from the fault storm); a distinct
+    # replica label gives this section's shed controller its own burn
+    # signal instead of a stale one — the 1-vCPU flake where warmup
+    # escalated off section-5's burn gauge.
     ov_sched = ContinuousScheduler(engine, ov_serving, settings=GREEDY,
-                                   overload=ov)
+                                   overload=ov, replica="ovdrill")
+
+    # Prime this scheduler's OWN prefill/cadence histograms (the deadline
+    # estimator reads its replica-labeled p50s and never sheds while
+    # telemetry is cold — two served requests warm it deterministically).
+    prime = [Request(prompt=PROMPTS["ok0"], id=f"ov_prime_{i}",
+                     settings=GREEDY) for i in range(2)]
+    prime_ok = all(r.ok for r in ov_sched.serve(prime))
+    check(prime_ok, "overload scheduler primed its replica-labeled "
+                    "prefill/cadence telemetry")
 
     # 7a. Deadline-feasibility admission: with six requests stacked ahead
     # on two slots, a 1 ms deadline is provably unmeetable — the gate must
     # shed it AT SUBMIT (no prefill burned, no expiry later), using the
-    # prefill/cadence telemetry the earlier sections populated.
+    # prefill/cadence telemetry the priming pass populated.
     warm = [Request(prompt=p, id=f"ov_warm_{i}", settings=GREEDY)
             for i, p in enumerate(list(PROMPTS.values())[:6])]
     for r in warm:
@@ -489,8 +503,10 @@ def main() -> int:
                      "across classes and shed/restore cycles")
     reg = T.get_registry()
     shed_batch = reg.read_value("shed_total", component="serving",
+                                replica="ovdrill",
                                 **{"class": "batch", "reason": "overload"})
     shed_doomed = reg.read_value("shed_total", component="serving",
+                                 replica="ovdrill",
                                  **{"class": "interactive",
                                     "reason": "deadline_infeasible"})
     check(shed_batch > 0 and shed_doomed > 0,
@@ -498,12 +514,19 @@ def main() -> int:
           f"deadline_infeasible={shed_doomed:g})")
     import time as _time
     ctl = ov_sched.shed_controller
-    deadline = _time.monotonic() + 10.0
-    while ctl.level > 0 and _time.monotonic() < deadline:
-        ctl.evaluate()
-        _time.sleep(0.02)
+    # Derived-time de-escalation (no sleeps, no wall deadline): the first
+    # evaluate sees a depth window aged past queue_window_s (every flood
+    # sample pruned -> frac 0), then each further evaluate advances the
+    # clock one healthy_window_s past the per-rung hysteresis restart —
+    # exactly one rung down per step, however slow the host is.
+    t = _time.monotonic() + ov.queue_window_s + 0.01
+    for _ in range(16):
+        if ctl.evaluate(now=t) == 0:
+            break
+        t += ov.healthy_window_s + 0.01
     check(ctl.level == 0 and reg.read_value(
-              "overload_level", component="serving") == 0,
+              "overload_level", component="serving",
+              replica="ovdrill") == 0,
           "shed controller de-escalated to level 0 after the flood")
 
     # 8. Fairness observability (ISSUE 9): the serving-neutrality audit and

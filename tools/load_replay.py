@@ -32,7 +32,14 @@ What it does, in one process, deterministically:
    asserts the two runs admitted the identical request set and produced
    the identical token map (half two of the determinism contract: a
    same-seed re-run reproduces the admitted-token set exactly);
-7. writes the telemetry snapshot for
+7. floods a deliberately under-provisioned one-replica fleet (tiny
+   queue, autoscaler pinned at 1) with a burst trace so the shed ladder
+   refuses admissions WITH retry-after advice, and asserts the driver
+   honored the advice (``replay_retry_after_honored_total`` >= 1: the
+   replay client backs off and re-offers instead of hammering the gate)
+   while the zero-loss ledger still closes (``lost == 0`` — every
+   honored retry ends in a terminal Result or a recorded re-shed);
+8. writes the telemetry snapshot for
    ``tools/validate_telemetry.py --require-autoscale`` (>=1 scale-up,
    >=1 scale-down, replay accepted == terminal, migrated == recovered,
    final fleet healthy).
@@ -301,7 +308,58 @@ def main() -> int:
         check(r1.tokens == r2.tokens,
               "same-seed re-run produced the identical admitted-token set")
 
-    # -- 7. snapshot ----------------------------------------------------------
+    # -- 7. retry-after honoring under deliberate overload --------------------
+    # A fleet sized to lose: one replica the autoscaler cannot grow, a
+    # queue a fraction of the drill's, and a depth-triggered shed ladder
+    # with a short fuse. The burst MUST drive class sheds carrying
+    # retry_after_s; the accounting question is what the driver does with
+    # them (honor once, then record the retry's verdict).
+    honored_before = reg.read_value("replay_retry_after_honored_total",
+                                    component="replay")
+    ov_cfg = trace_config(a.seed + 2, a.duration / 4.0, burst=True)
+    ov_cfg = dataclasses.replace(
+        ov_cfg, base_sessions_per_s=4.0, interactive_frac=0.5,
+        session_max_turns=2, think_time_s=0.5,
+    )
+    ov_events = generate_trace(ov_cfg, PROMPTS)
+    ov_fleet = ReplicaSet(
+        engine,
+        dataclasses.replace(SERVING, queue_capacity=6),
+        settings=GREEDY,
+        fleet=FleetConfig(replicas=1, fence_cooldown_s=0.3),
+        resilience=RESILIENCE,
+        integrity=IntegrityConfig(canary_max_tokens=8),
+        name="ovreplay",
+        overload=OverloadConfig(
+            enabled=True, aging_s=1.0, deadline_admission=False,
+            queue_frac_threshold=0.5, queue_window_s=0.3,
+            healthy_window_s=0.3, eval_interval_s=0.02,
+            burn_threshold=50.0,  # depth-driven: keep the trigger local
+            retry_after_s=0.05,
+        ),
+        autoscale=AutoscaleConfig(enabled=True, min_replicas=1,
+                                  max_replicas=1),
+    )
+    ov_report = ReplayDriver(
+        ov_fleet, ov_events, compression=2.0 * a.compression,
+        max_wall_s=a.max_wall, tail_s=0.5 * ov_cfg.duration_s,
+    ).run()
+    print("overload replay:", ov_report.summary())
+    honored = reg.read_value("replay_retry_after_honored_total",
+                             component="replay") - honored_before
+    check(ov_report.gate_sheds >= 1,
+          f"under-provisioned fleet shed at the gate "
+          f"({ov_report.gate_sheds} gate sheds)")
+    check(honored >= 1,
+          f"driver honored retry_after_s on shed results "
+          f"({honored:g} backoffs taken before re-offer)")
+    check(not ov_report.timed_out and ov_report.lost == 0
+          and ov_report.dropped == 0,
+          f"overload replay ledger closed: zero accepted-then-lost, zero "
+          f"dropped ({ov_report.accepted} accepted, "
+          f"{ov_report.terminal} terminal)")
+
+    # -- 8. snapshot ----------------------------------------------------------
     if a.telemetry_dir:
         path = T.write_snapshot(T.get_registry(), a.telemetry_dir)
         bad_snap = T.validate_snapshot(T.load_snapshot(path))

@@ -70,6 +70,14 @@ snapshot, trace slice — no torn ``.partial`` leftovers), a nonzero
 with the bundles on disk. ``--forbid-incidents`` is the inverse gate for
 fault-free runs: ZERO bundles — an incident bundle from a clean study is
 itself a defect.
+``--require-rollout`` requires the zero-downtime upgrade evidence the
+rollout drill produces (ISSUE 20): at least one
+``rollout_transitions_total{to="complete"}`` AND one ``{to="rolled_back"}``
+(the clean upgrade and the gate-triggered abort both happened), every
+``rollout_rollbacks_total`` entry carrying a NAMED gate cause, every
+``rollout_state`` gauge terminal (never abandoned mid-wave), and fleet
+migration counters balanced (``fleet_migrated_requests_total`` equal to
+``fleet_migrated_recovered_total`` — rollback re-fencing lost nothing).
 ``--require-fairness`` requires the fairness-observability signals a
 fault-free ``--fairness-obs --continuous`` study produces (ISSUE 9):
 nonzero ``fairness_requests_total`` and ``fairness_pairs_joined_total``,
@@ -105,6 +113,7 @@ def check(path: str, require_serving: bool = False,
           require_costmodel: bool = False,
           require_incidents: bool = False,
           require_memory: bool = False,
+          require_rollout: bool = False,
           forbid_incidents: bool = False) -> int:
     snap = load_snapshot(path)
     problems = list(validate_snapshot(snap))
@@ -118,6 +127,8 @@ def check(path: str, require_serving: bool = False,
         problems.extend(_check_costmodel(snap))
     if require_memory:
         problems.extend(_check_memory(snap))
+    if require_rollout:
+        problems.extend(_check_rollout(snap))
     if require_fairness:
         problems.extend(_check_fairness(snap))
     if require_autoscale:
@@ -408,6 +419,63 @@ def _check_costmodel(snap: dict) -> list:
                 "component in cost_ledger_bytes (tensor-parallel comm "
                 "never attributed)"
             )
+    return problems
+
+
+def _check_rollout(snap: dict) -> list:
+    """The --require-rollout gate (ISSUE 20): the rollout drill completed
+    one upgrade AND rolled at least one back through a named gate, every
+    rollout reached a terminal state, and migration accounting balanced
+    (no request lost crossing a fenced new-version replica)."""
+    problems = []
+    counters = snap.get("counters", [])
+
+    def total(name, **want):
+        return sum(
+            c["value"] for c in counters if c.get("name") == name
+            and all(c.get("labels", {}).get(k) == v
+                    for k, v in want.items())
+        )
+
+    if not total("rollout_transitions_total", to="complete"):
+        problems.append(
+            "no rollout reached complete (the clean-upgrade half of the "
+            "drill never finished a wave sequence)"
+        )
+    if not total("rollout_transitions_total", to="rolled_back"):
+        problems.append(
+            "no rollout ever rolled back (the gate half of the drill "
+            "never fired)"
+        )
+    causes = {
+        c.get("labels", {}).get("cause")
+        for c in counters
+        if c.get("name") == "rollout_rollbacks_total" and c["value"] > 0
+    }
+    if not causes or None in causes:
+        problems.append(
+            "rollout_rollbacks_total carries no named gate cause (a "
+            "rollback must say WHICH deployment gate fired)"
+        )
+    # Terminal-state proof: every fleet's rollout_state gauge must end in
+    # rolled_back or complete — a mid-wave state in the final snapshot
+    # means a rollout was abandoned, not resolved.
+    terminal = {6.0, 7.0}  # ROLLOUT_STATES indices: rolled_back, complete
+    for g in snap.get("gauges", []):
+        if g.get("name") == "rollout_state" \
+                and g.get("value") not in terminal:
+            problems.append(
+                f"rollout_state {g.get('labels', {})} ended mid-wave "
+                f"(value {g.get('value')}) — rollout neither completed "
+                "nor rolled back"
+            )
+    migrated = total("fleet_migrated_requests_total")
+    recovered = total("fleet_migrated_recovered_total")
+    if migrated != recovered:
+        problems.append(
+            f"fleet migration accounting unbalanced across the rollout "
+            f"({migrated:g} migrated != {recovered:g} recovered)"
+        )
     return problems
 
 
@@ -758,6 +826,7 @@ def main() -> int:
     ap.add_argument("--require-costmodel", action="store_true")
     ap.add_argument("--require-incidents", action="store_true")
     ap.add_argument("--require-memory", action="store_true")
+    ap.add_argument("--require-rollout", action="store_true")
     ap.add_argument("--forbid-incidents", action="store_true")
     a = ap.parse_args()
     return check(a.path, require_serving=a.require_serving,
@@ -772,6 +841,7 @@ def main() -> int:
                  require_costmodel=a.require_costmodel,
                  require_incidents=a.require_incidents,
                  require_memory=a.require_memory,
+                 require_rollout=a.require_rollout,
                  forbid_incidents=a.forbid_incidents)
 
 
